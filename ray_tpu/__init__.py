@@ -1,0 +1,193 @@
+"""ray_tpu — a TPU-native distributed AI runtime.
+
+Public API parity target: reference python/ray/_private/worker.py
+(init:1286, shutdown:1931, get:2718, put:2854, wait:2919, remote:3407).
+"""
+
+from __future__ import annotations
+
+import atexit
+import logging
+import threading
+from typing import Any, Iterable, Sequence
+
+from ray_tpu import exceptions  # noqa: F401
+from ray_tpu._private.bootstrap import HeadNode
+from ray_tpu._private.rtconfig import CONFIG
+from ray_tpu._private.worker import ObjectRef, Worker, global_worker, set_global_worker
+from ray_tpu.actor import ActorClass, ActorHandle, get_actor, kill  # noqa: F401
+from ray_tpu.remote_function import RemoteFunction
+
+__version__ = "0.1.0"
+
+logger = logging.getLogger(__name__)
+
+_head: HeadNode | None = None
+_init_lock = threading.Lock()
+
+
+def is_initialized() -> bool:
+    return global_worker() is not None
+
+
+def init(
+    address: str | None = None,
+    *,
+    num_cpus: float | None = None,
+    num_tpus: float | None = None,
+    resources: dict[str, float] | None = None,
+    labels: dict[str, str] | None = None,
+    namespace: str = "default",
+    runtime_env: dict | None = None,
+    ignore_reinit_error: bool = False,
+    _system_config: dict | None = None,
+    _worker_env: dict | None = None,
+):
+    """Start (or connect to) a cluster and attach this process as the driver.
+
+    With no `address`, brings up an in-process head (controller + node agent,
+    cf. reference node.py:1437 start_head_processes) and a worker pool of
+    subprocesses. With `address="host:port"`, connects to a running cluster
+    (started via `ray-tpu start --head`).
+    """
+    global _head
+    with _init_lock:
+        if global_worker() is not None:
+            if ignore_reinit_error:
+                return
+            raise RuntimeError("ray_tpu.init() called twice; use shutdown() first.")
+        CONFIG.apply_system_config(_system_config)
+        if address is None:
+            _head = HeadNode(
+                num_cpus=num_cpus,
+                num_tpus=num_tpus,
+                resources=resources,
+                labels=labels,
+                worker_env=_worker_env,
+            )
+            controller_addr = _head.start()
+            session_id = _head.session_id
+        else:
+            host, port = address.rsplit(":", 1)
+            controller_addr = (host, int(port))
+            # Session id is learned from the controller at register time.
+            session_id = "remote"
+        w = Worker(mode="driver", session_id=session_id, controller_addr=controller_addr)
+        w.connect()
+        if address is not None:
+            # Adopt the cluster's session id for the shared shm namespace.
+            rep = w.io.run(w.controller.call("ping"))
+            w.session_id = rep["session_id"]
+            w.store.session = rep["session_id"][:8]
+        w.namespace = namespace
+        set_global_worker(w)
+        atexit.register(shutdown)
+        return w
+
+
+def shutdown():
+    global _head
+    w = global_worker()
+    if w is not None:
+        w.disconnect()
+    if _head is not None:
+        _head.stop()
+        _head = None
+    try:
+        atexit.unregister(shutdown)
+    except Exception:
+        pass
+
+
+def _require_worker() -> Worker:
+    w = global_worker()
+    if w is None:
+        raise RuntimeError("ray_tpu.init() has not been called.")
+    return w
+
+
+def remote(*args, **options):
+    """@remote decorator for functions and classes (reference worker.py:3407)."""
+    import inspect
+
+    def decorate(obj):
+        if inspect.isclass(obj):
+            return ActorClass(obj, options)
+        return RemoteFunction(obj, options)
+
+    if len(args) == 1 and not options and (inspect.isfunction(args[0]) or inspect.isclass(args[0])):
+        return decorate(args[0])
+    if args:
+        raise TypeError("@remote takes keyword options only, e.g. @remote(num_cpus=2)")
+    return decorate
+
+
+def put(value) -> ObjectRef:
+    return _require_worker().put(value)
+
+
+def get(refs, timeout: float | None = None):
+    w = _require_worker()
+    if isinstance(refs, ObjectRef):
+        return w.get([refs], timeout=timeout)[0]
+    if not isinstance(refs, (list, tuple)):
+        raise TypeError(f"get() expects an ObjectRef or list, got {type(refs)}")
+    for r in refs:
+        if not isinstance(r, ObjectRef):
+            raise TypeError(f"get() list must contain only ObjectRefs, got {type(r)}")
+    return w.get(list(refs), timeout=timeout)
+
+
+def wait(
+    refs: Sequence[ObjectRef],
+    *,
+    num_returns: int = 1,
+    timeout: float | None = None,
+    fetch_local: bool = True,
+):
+    w = _require_worker()
+    if isinstance(refs, ObjectRef):
+        raise TypeError("wait() expects a list of ObjectRefs")
+    return w.wait(list(refs), num_returns=num_returns, timeout=timeout)
+
+
+def cluster_resources() -> dict[str, float]:
+    return _require_worker().cluster_resources()["total"]
+
+
+def available_resources() -> dict[str, float]:
+    return _require_worker().cluster_resources()["available"]
+
+
+def nodes() -> list[dict]:
+    snap = _require_worker().state_snapshot()
+    return [
+        {"NodeID": nid, "Alive": n["alive"], "Resources": n["total"], "Labels": n["labels"]}
+        for nid, n in snap["nodes"].items()
+    ]
+
+
+def timeline() -> list[dict]:
+    """Task-event timeline (reference ray.timeline(), _private/state.py:965).
+    Round 1: returns the controller's state snapshot; chrome-trace export TBD."""
+    return _require_worker().state_snapshot()
+
+
+__all__ = [
+    "init",
+    "shutdown",
+    "is_initialized",
+    "remote",
+    "put",
+    "get",
+    "wait",
+    "kill",
+    "get_actor",
+    "ObjectRef",
+    "ActorHandle",
+    "cluster_resources",
+    "available_resources",
+    "nodes",
+    "exceptions",
+    "__version__",
+]

@@ -1,0 +1,63 @@
+"""TPU accelerator detection — TPU chips are first-class schedulable resources.
+
+Parity target: reference python/ray/_private/accelerators/tpu.py:109
+(TPUAcceleratorManager — detects chips via /dev/accel* & /dev/vfio
+tpu.py:135-150, sets TPU_VISIBLE_CHIPS, knows pod topology, e.g.
+get_num_workers_in_current_tpu_pod tpu.py:312). Unlike the reference — where
+TPU support is one plugin among many — this runtime treats "TPU" like the
+reference treats GPU, and additionally advertises slice-level gang resources
+("TPU-<accel>-<topology>-head") so pod-scale jobs can be placed atomically.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+
+TPU_RESOURCE = "TPU"
+
+
+def num_tpu_chips() -> int:
+    """Detect the number of TPU chips on this host."""
+    env = os.environ.get("RT_NUM_TPUS") or os.environ.get("TPU_CHIPS")
+    if env:
+        return int(env)
+    visible = os.environ.get("TPU_VISIBLE_CHIPS")
+    if visible:
+        return len([c for c in visible.split(",") if c.strip()])
+    # Device-file probing, same sources as the reference (tpu.py:135-150).
+    n = len(glob.glob("/dev/accel*"))
+    if n == 0 and os.path.isdir("/dev/vfio"):
+        n = len([f for f in os.listdir("/dev/vfio") if f != "vfio"])
+    return n
+
+
+def tpu_generation() -> str | None:
+    """e.g. 'v5e' | 'v4' — from env (GKE sets TPU_ACCELERATOR_TYPE)."""
+    accel = os.environ.get("TPU_ACCELERATOR_TYPE")  # e.g. "v5litepod-16"
+    if accel:
+        return accel.split("-")[0].replace("litepod", "5e").replace("v5lite", "v5e")
+    return None
+
+
+def tpu_pod_resources() -> dict[str, float]:
+    """Extra pod-topology resources for this host (slice head marker etc.).
+    Mirrors the reference's `TPU-{accel}-head` custom resource that lets a
+    single task gang-own a pod slice (tpu.py get_current_pod_name/worker
+    count)."""
+    out: dict[str, float] = {}
+    accel = os.environ.get("TPU_ACCELERATOR_TYPE")
+    worker_id = os.environ.get("TPU_WORKER_ID")
+    if accel and (worker_id is None or worker_id == "0"):
+        out[f"TPU-{accel}-head"] = 1.0
+    return out
+
+
+def host_resources(num_cpus: float | None = None, num_tpus: float | None = None) -> dict[str, float]:
+    r: dict[str, float] = {}
+    r["CPU"] = float(num_cpus) if num_cpus is not None else float(os.cpu_count() or 1)
+    chips = num_tpus if num_tpus is not None else num_tpu_chips()
+    if chips:
+        r[TPU_RESOURCE] = float(chips)
+        r.update(tpu_pod_resources())
+    return r

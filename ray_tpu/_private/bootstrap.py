@@ -1,0 +1,90 @@
+"""In-process head bootstrap: controller + head node agent.
+
+Parity target: reference python/ray/_private/node.py
+(start_head_processes:1437 — spawns the gcs_server and raylet C++ binaries as
+daemons). TPU-era simplification: the control plane is asyncio services, so a
+single-host cluster hosts controller + head agent on the driver's IO loop
+thread — zero extra processes beyond the worker pool; `ray-tpu start` runs
+the same objects standalone for multi-host clusters.
+"""
+
+from __future__ import annotations
+
+import os
+import uuid
+
+from ray_tpu._private import rpc
+from ray_tpu._private.accelerators import host_resources
+from ray_tpu._private.controller import Controller
+from ray_tpu._private.ids import NodeID
+from ray_tpu._private.node_agent import NodeAgent
+from ray_tpu._private.resources import ResourceSet
+from ray_tpu._private.rtconfig import CONFIG
+
+
+class HeadNode:
+    """Controller + head NodeAgent living on one event loop thread."""
+
+    def __init__(
+        self,
+        num_cpus: float | None = None,
+        num_tpus: float | None = None,
+        resources: dict | None = None,
+        labels: dict | None = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        worker_env: dict | None = None,
+    ):
+        self.session_id = uuid.uuid4().hex
+        self.host = host
+        self.port = port
+        res = host_resources(num_cpus, num_tpus)
+        res.update(resources or {})
+        self.resources = ResourceSet(res)
+        self.labels = labels or {}
+        self.worker_env = worker_env
+        self.io = rpc.EventLoopThread(name="rt-head")
+        self.controller: Controller | None = None
+        self.agent: NodeAgent | None = None
+        self.node_id = NodeID.from_random().hex()
+        self.controller_addr: tuple | None = None
+
+    def start(self) -> tuple:
+        async def _up():
+            self.controller = Controller(self.session_id)
+            port = await self.controller.start(self.host, self.port)
+            self.controller_addr = (self.host, port)
+            self.agent = NodeAgent(
+                node_id=self.node_id,
+                session_id=self.session_id,
+                controller_addr=self.controller_addr,
+                resources_raw=self.resources.raw(),
+                labels=self.labels,
+                host=self.host,
+                env=self.worker_env,
+            )
+            await self.agent.start()
+
+        self.io.run(_up(), timeout=CONFIG.connect_timeout_s)
+        return self.controller_addr
+
+    def stop(self):
+        async def _down():
+            if self.agent is not None:
+                await self.agent.stop()
+            if self.controller is not None:
+                await self.controller.stop()
+
+        try:
+            self.io.run(_down(), timeout=10)
+        except Exception:
+            pass
+        self.io.stop()
+        # Clean any session shm leftovers.
+        import glob
+
+        for p in glob.glob(os.path.join(CONFIG.shm_dir, f"rt_{self.session_id[:8]}_*")):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass

@@ -1,0 +1,691 @@
+"""Cluster controller — the control plane of the runtime.
+
+Parity target: the reference GCS server (src/ray/gcs/gcs_server/gcs_server.h:90
+and its per-domain managers: GcsNodeManager, GcsActorManager
+(gcs_actor_manager.cc:1410 max_restarts), GcsPlacementGroupManager,
+GcsJobManager, internal KV (gcs_kv_manager.h), GcsHealthCheckManager
+(gcs_health_check_manager.h:45)) PLUS the GCS-side ClusterTaskManager: unlike
+the reference — which scheduls most tasks on per-node raylets with spillback —
+this controller makes all placement decisions centrally. TPU-era rationale:
+slices are long-lived gang-scheduled resources; central decisions avoid the
+raylet spillback dance (normal_task_submitter.cc:461) entirely.
+
+Also plays the object directory role (reference
+ownership_object_directory.h): oid -> holder addresses, with inline storage
+for small objects (reference CoreWorkerMemoryStore memory_store.h:45).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from collections import deque
+from typing import Optional
+
+from ray_tpu._private import rpc
+from ray_tpu._private.resources import ResourceSet
+from ray_tpu._private.rtconfig import CONFIG
+from ray_tpu._private.scheduler import NodeState, pick_node
+from ray_tpu._private.task_spec import ACTOR_CREATE, TaskSpec
+
+logger = logging.getLogger(__name__)
+
+
+class _ObjectEntry:
+    __slots__ = ("state", "inline", "holders", "size", "waiters", "owner", "error")
+
+    def __init__(self):
+        self.state = "pending"  # pending | ready | lost
+        self.inline = None  # list[bytes] | None
+        self.holders: set[tuple] = set()
+        self.size = 0
+        self.waiters: list[asyncio.Future] = []
+        self.owner: Optional[str] = None
+        self.error = None  # serialized error blob (parts) shared with owner
+
+    def wake(self):
+        for fut in self.waiters:
+            if not fut.done():
+                fut.set_result(None)
+        self.waiters.clear()
+
+
+class _ActorEntry:
+    __slots__ = (
+        "spec", "state", "node_id", "worker_id", "address", "instance",
+        "restarts_used", "name", "namespace", "death_cause", "waiters",
+    )
+
+    def __init__(self, spec: TaskSpec):
+        self.spec = spec
+        self.state = "PENDING"  # PENDING | ALIVE | RESTARTING | DEAD
+        self.node_id = None
+        self.worker_id = None
+        self.address = None  # (host, port) of hosting worker's RPC server
+        self.instance = 0  # bumped every restart so stale handles re-resolve
+        self.restarts_used = 0
+        self.name = spec.actor_name
+        self.namespace = spec.namespace
+        self.death_cause = None
+        self.waiters: list[asyncio.Future] = []
+
+    def wake(self):
+        for fut in self.waiters:
+            if not fut.done():
+                fut.set_result(None)
+        self.waiters.clear()
+
+
+class Controller:
+    def __init__(self, session_id: str):
+        self.session_id = session_id
+        self.server = rpc.RpcServer(self._on_request, self._on_push, self._on_conn_close)
+        self.nodes: dict[str, NodeState] = {}
+        self.node_conns: dict[str, rpc.Connection] = {}
+        self.client_conns: dict[str, rpc.Connection] = {}  # worker_id -> conn
+        self.objects: dict[str, _ObjectEntry] = {}
+        self.pending: deque[TaskSpec] = deque()
+        # task_id -> {"spec", "node_id", "worker_id"}
+        self.dispatched: dict[str, dict] = {}
+        self.actors: dict[str, _ActorEntry] = {}
+        self.named_actors: dict[tuple, str] = {}
+        self.pgs: dict[str, dict] = {}
+        self.pg_bundles: dict[tuple, dict] = {}  # (pg_id, idx) -> {node, available, reserved}
+        self.kv: dict[tuple, bytes] = {}
+        self._sched_wakeup = asyncio.Event()
+        self._tasks: list[asyncio.Task] = []
+        self._stopping = False
+        self.port = 0
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self.port = await self.server.start(host, port)
+        self._tasks.append(asyncio.ensure_future(self._schedule_loop()))
+        self._tasks.append(asyncio.ensure_future(self._health_loop()))
+        return self.port
+
+    async def stop(self):
+        self._stopping = True
+        for nid, conn in list(self.node_conns.items()):
+            try:
+                await conn.push("shutdown")
+            except Exception:
+                pass
+        for t in self._tasks:
+            t.cancel()
+        await self.server.stop()
+
+    # ------------------------------------------------------------------ RPC
+    async def _on_request(self, conn: rpc.Connection, method: str, a: dict):
+        handler = getattr(self, f"_h_{method}", None)
+        if handler is None:
+            raise rpc.RpcError(f"controller: unknown method {method}")
+        return await handler(conn, a)
+
+    async def _on_push(self, conn: rpc.Connection, method: str, a: dict):
+        handler = getattr(self, f"_p_{method}", None)
+        if handler is None:
+            logger.warning("controller: unknown push %s", method)
+            return
+        await handler(conn, a)
+
+    def _on_conn_close(self, conn: rpc.Connection):
+        if self._stopping:
+            return
+        kind = conn.meta.get("kind")
+        if kind == "node":
+            nid = conn.meta["node_id"]
+            asyncio.ensure_future(self._node_died(nid))
+        elif kind == "client":
+            wid = conn.meta.get("worker_id")
+            self.client_conns.pop(wid, None)
+
+    # ------------------------------------------------------- registration
+    async def _h_register(self, conn, a):
+        if a["kind"] == "node":
+            nid = a["node_id"]
+            node = NodeState(nid, tuple(a["address"]), ResourceSet(_raw=a["resources"]), a.get("labels"))
+            node.last_beat = time.monotonic()
+            self.nodes[nid] = node
+            self.node_conns[nid] = conn
+            conn.meta.update(kind="node", node_id=nid)
+            self._kick()
+            logger.info("node %s registered with %s", nid[:8], node.total.to_dict())
+        else:
+            wid = a["worker_id"]
+            self.client_conns[wid] = conn
+            conn.meta.update(kind="client", worker_id=wid, address=tuple(a["address"]) if a.get("address") else None)
+        return {"session_id": self.session_id, "config": CONFIG.snapshot()}
+
+    async def _p_heartbeat(self, conn, a):
+        node = self.nodes.get(a["node_id"])
+        if node is not None:
+            node.last_beat = time.monotonic()
+
+    # ---------------------------------------------------------- scheduling
+    def _kick(self):
+        self._sched_wakeup.set()
+
+    async def _schedule_loop(self):
+        while True:
+            await self._sched_wakeup.wait()
+            self._sched_wakeup.clear()
+            await self._schedule_once()
+
+    async def _schedule_once(self):
+        # Single pass over the queue; tasks that can't be placed stay queued.
+        still_pending: deque[TaskSpec] = deque()
+        while self.pending:
+            spec = self.pending.popleft()
+            demand = ResourceSet(_raw=spec.resources)
+            nid = pick_node(demand, spec.strategy, self.nodes, self.pg_bundles)
+            if nid is None:
+                still_pending.append(spec)
+                continue
+            self._consume(nid, spec, demand)
+            ok = await self._dispatch(nid, spec)
+            if not ok:
+                self._release(nid, spec, demand)
+                still_pending.append(spec)
+        self.pending.extend(still_pending)
+
+    def _consume(self, nid: str, spec: TaskSpec, demand: ResourceSet):
+        if spec.strategy.kind == "PLACEMENT_GROUP":
+            # PG resources were reserved from the node at PG creation.
+            for (pgid, idx), b in self.pg_bundles.items():
+                if pgid == spec.strategy.pg_id and b["node"] == nid and b["available"].fits(demand):
+                    if spec.strategy.pg_bundle_index in (-1, idx):
+                        b["available"].subtract(demand)
+                        spec.strategy.pg_bundle_index = idx  # pin for release
+                        return
+        self.nodes[nid].available.subtract(demand)
+
+    def _release(self, nid: str, spec: TaskSpec, demand: ResourceSet):
+        if spec.strategy.kind == "PLACEMENT_GROUP":
+            b = self.pg_bundles.get((spec.strategy.pg_id, spec.strategy.pg_bundle_index))
+            if b is not None:
+                b["available"].add(demand)
+                return
+        node = self.nodes.get(nid)
+        if node is not None:
+            node.available.add(demand)
+
+    async def _dispatch(self, nid: str, spec: TaskSpec) -> bool:
+        conn = self.node_conns.get(nid)
+        if conn is None or conn.closed:
+            return False
+        try:
+            rep = await conn.call("dispatch", spec=spec)
+        except rpc.RpcError:
+            return False
+        self.dispatched[spec.task_id] = {"spec": spec, "node_id": nid, "worker_id": rep["worker_id"]}
+        if spec.kind == ACTOR_CREATE:
+            ent = self.actors.get(spec.actor_id)
+            if ent is not None:
+                ent.node_id = nid
+                ent.worker_id = rep["worker_id"]
+        return True
+
+    async def _h_submit_task(self, conn, a):
+        spec: TaskSpec = a["spec"]
+        for oid in spec.return_object_ids():
+            ent = self.objects.setdefault(oid, _ObjectEntry())
+            ent.owner = spec.owner_id
+        self.pending.append(spec)
+        self._kick()
+        return {"queued": True}
+
+    # ------------------------------------------------------ task completion
+    async def _p_task_done(self, conn, a):
+        task_id = a["task_id"]
+        info = self.dispatched.pop(task_id, None)
+        spec: Optional[TaskSpec] = info["spec"] if info else a.get("spec")
+        if info is not None and spec.kind != ACTOR_CREATE:
+            self._release(info["node_id"], spec, ResourceSet(_raw=spec.resources))
+            self._kick()
+
+        if spec is not None and spec.kind == ACTOR_CREATE:
+            await self._actor_started(spec, a, info)
+            return
+
+        error = a.get("error")
+        for oid, inline, size, holder in a.get("results", []):
+            ent = self.objects.setdefault(oid, _ObjectEntry())
+            if error is not None:
+                ent.error = error
+            ent.state = "ready"
+            ent.inline = inline
+            ent.size = size
+            if holder is not None:
+                ent.holders.add(tuple(holder))
+            ent.wake()
+            await self._notify_owner(ent, oid)
+
+    async def _notify_owner(self, ent: _ObjectEntry, oid: str):
+        owner_conn = self.client_conns.get(ent.owner)
+        if owner_conn is not None and not owner_conn.closed:
+            try:
+                await owner_conn.push(
+                    "object_ready",
+                    oid=oid,
+                    inline=ent.inline,
+                    holders=list(ent.holders),
+                    error=ent.error,
+                )
+            except Exception:
+                pass
+
+    async def _p_task_failed(self, conn, a):
+        """Worker/system failure (not a user exception): retry or fail."""
+        task_id = a["task_id"]
+        info = self.dispatched.pop(task_id, None)
+        if info is None:
+            return
+        spec: TaskSpec = info["spec"]
+        if spec.kind != ACTOR_CREATE:
+            self._release(info["node_id"], spec, ResourceSet(_raw=spec.resources))
+        await self._retry_or_fail(spec, a.get("reason", "worker died"))
+        self._kick()
+
+    async def _retry_or_fail(self, spec: TaskSpec, reason: str):
+        if spec.kind == ACTOR_CREATE:
+            await self._maybe_restart_actor(spec.actor_id, reason)
+            return
+        if spec.attempt < spec.max_retries:
+            spec.attempt += 1
+            logger.info("retrying task %s (attempt %d): %s", spec.name, spec.attempt, reason)
+            await asyncio.sleep(CONFIG.task_retry_delay_s)
+            self.pending.append(spec)
+            self._kick()
+            return
+        from ray_tpu._private.serialization import dumps_oob
+
+        err_header, err_bufs = dumps_oob({"type": "WorkerCrashedError", "message": reason})
+        for oid in spec.return_object_ids():
+            ent = self.objects.setdefault(oid, _ObjectEntry())
+            ent.state = "ready"
+            ent.error = [err_header, *err_bufs]
+            ent.wake()
+            await self._notify_owner(ent, oid)
+
+    # ------------------------------------------------------------- objects
+    async def _h_register_put(self, conn, a):
+        ent = self.objects.setdefault(a["oid"], _ObjectEntry())
+        ent.state = "ready"
+        ent.owner = a.get("owner") or conn.meta.get("worker_id")
+        ent.size = a["size"]
+        if a.get("inline") is not None:
+            ent.inline = a["inline"]
+        if a.get("holder") is not None:
+            ent.holders.add(tuple(a["holder"]))
+        if a.get("error") is not None:
+            ent.error = a["error"]
+        ent.wake()
+        return {}
+
+    async def _p_register_put(self, conn, a):
+        """Push variant (no ack) — used by actor workers to advertise call
+        results without adding a round trip to the direct-call fast path."""
+        await self._h_register_put(conn, a)
+
+    async def _p_add_location(self, conn, a):
+        ent = self.objects.get(a["oid"])
+        if ent is not None:
+            ent.holders.add(tuple(a["holder"]))
+
+    async def _h_wait_object(self, conn, a):
+        oid = a["oid"]
+        timeout = a.get("timeout")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            ent = self.objects.setdefault(oid, _ObjectEntry())
+            if ent.state == "ready":
+                return {
+                    "status": "ready",
+                    "inline": ent.inline,
+                    "holders": list(ent.holders),
+                    "error": ent.error,
+                }
+            if ent.state == "lost":
+                return {"status": "lost"}
+            fut = asyncio.get_running_loop().create_future()
+            ent.waiters.append(fut)
+            try:
+                remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
+                await asyncio.wait_for(fut, remaining)
+            except asyncio.TimeoutError:
+                return {"status": "timeout"}
+
+    async def _h_check_objects(self, conn, a):
+        """Bulk readiness probe (backs `wait()`, cf. reference WaitManager
+        raylet/wait_manager.h)."""
+        out = []
+        for oid in a["oids"]:
+            ent = self.objects.get(oid)
+            out.append(ent is not None and ent.state == "ready")
+        return {"ready": out}
+
+    async def _p_free_objects(self, conn, a):
+        oids = a["oids"]
+        for oid in oids:
+            self.objects.pop(oid, None)
+        for nconn in self.node_conns.values():
+            if not nconn.closed:
+                try:
+                    await nconn.push("free", oids=oids)
+                except Exception:
+                    pass
+
+    # ------------------------------------------------------------- actors
+    async def _h_create_actor(self, conn, a):
+        spec: TaskSpec = a["spec"]
+        if spec.actor_name:
+            key = (spec.namespace, spec.actor_name)
+            existing = self.named_actors.get(key)
+            if existing is not None and self.actors[existing].state != "DEAD":
+                if spec.get_if_exists:
+                    return {"actor_id": existing, "existing": True}
+                raise rpc.RpcError(f"Actor name {spec.actor_name!r} already taken")
+            self.named_actors[key] = spec.actor_id
+        self.actors[spec.actor_id] = _ActorEntry(spec)
+        self.pending.append(spec)
+        self._kick()
+        return {"actor_id": spec.actor_id, "existing": False}
+
+    async def _actor_started(self, spec: TaskSpec, a: dict, info):
+        ent = self.actors.get(spec.actor_id)
+        if ent is None:
+            return
+        if a.get("error") is not None:
+            # Actor __init__ raised: actor is DEAD with that cause.
+            ent.state = "DEAD"
+            ent.death_cause = a["error"]
+            self._release_actor_resources(ent)
+            ent.wake()
+            return
+        ent.state = "ALIVE"
+        ent.address = tuple(a["actor_address"])
+        ent.instance += 1
+        ent.wake()
+        logger.info("actor %s alive at %s", spec.name, ent.address)
+
+    def _release_actor_resources(self, ent: _ActorEntry):
+        if ent.node_id is not None:
+            self._release(ent.node_id, ent.spec, ResourceSet(_raw=ent.spec.resources))
+            self._kick()
+
+    async def _h_get_actor_info(self, conn, a):
+        actor_id = a.get("actor_id")
+        if actor_id is None:
+            key = (a.get("namespace", "default"), a["name"])
+            actor_id = self.named_actors.get(key)
+            if actor_id is None:
+                return {"status": "not_found"}
+        ent = self.actors.get(actor_id)
+        if ent is None:
+            return {"status": "not_found"}
+        deadline = time.monotonic() + a.get("timeout", 60.0)
+        while ent.state in ("PENDING", "RESTARTING") and a.get("wait", True):
+            fut = asyncio.get_running_loop().create_future()
+            ent.waiters.append(fut)
+            try:
+                await asyncio.wait_for(fut, max(0.0, deadline - time.monotonic()))
+            except asyncio.TimeoutError:
+                break
+        return {
+            "status": "ok",
+            "actor_id": actor_id,
+            "state": ent.state,
+            "address": ent.address,
+            "instance": ent.instance,
+            "death_cause": ent.death_cause,
+            "max_task_retries": ent.spec.max_task_retries,
+        }
+
+    async def _h_kill_actor(self, conn, a):
+        ent = self.actors.get(a["actor_id"])
+        if ent is None:
+            return {}
+        if a.get("no_restart", True):
+            ent.spec.max_restarts = 0
+        if ent.worker_id is not None and ent.node_id in self.node_conns:
+            try:
+                await self.node_conns[ent.node_id].push("kill_worker", worker_id=ent.worker_id)
+            except Exception:
+                pass
+        await self._actor_worker_died(a["actor_id"], "killed via kill()")
+        return {}
+
+    async def _maybe_restart_actor(self, actor_id: str, reason: str):
+        ent = self.actors.get(actor_id)
+        if ent is None:
+            return
+        max_restarts = ent.spec.max_restarts
+        if max_restarts == -1 or ent.restarts_used < max_restarts:
+            ent.restarts_used += 1
+            ent.state = "RESTARTING"
+            ent.address = None
+            logger.info("restarting actor %s (%d used): %s", ent.spec.name, ent.restarts_used, reason)
+            respawn = ent.spec
+            respawn.attempt += 1
+            self.pending.append(respawn)
+            self._kick()
+        else:
+            ent.state = "DEAD"
+            from ray_tpu._private.serialization import dumps_oob
+
+            h, b = dumps_oob({"type": "ActorDiedError", "message": reason})
+            ent.death_cause = [h, *b]
+            self._release_actor_resources(ent)
+            ent.wake()
+            if ent.name:
+                self.named_actors.pop((ent.namespace, ent.name), None)
+
+    async def _actor_worker_died(self, actor_id: str, reason: str):
+        ent = self.actors.get(actor_id)
+        if ent is None or ent.state == "DEAD":
+            return
+        # Drop any in-flight creation bookkeeping.
+        self.dispatched.pop(ent.spec.task_id, None)
+        self._release_actor_resources(ent)
+        await self._maybe_restart_actor(actor_id, reason)
+
+    async def _p_worker_died(self, conn, a):
+        """Node agent reports a worker process exit."""
+        actor_id = a.get("actor_id")
+        task_id = a.get("task_id")
+        if actor_id:
+            await self._actor_worker_died(actor_id, f"worker process died: {a.get('reason', '')}")
+        if task_id:
+            info = self.dispatched.pop(task_id, None)
+            if info is not None:
+                spec = info["spec"]
+                if spec.kind != ACTOR_CREATE:
+                    self._release(info["node_id"], spec, ResourceSet(_raw=spec.resources))
+                await self._retry_or_fail(spec, "worker process died")
+                self._kick()
+
+    # ------------------------------------------------------- node failure
+    async def _node_died(self, nid: str):
+        node = self.nodes.get(nid)
+        if node is None or not node.alive:
+            return
+        node.alive = False
+        self.node_conns.pop(nid, None)
+        logger.warning("node %s died", nid[:8])
+        # Retry tasks that were running there.
+        for task_id, info in list(self.dispatched.items()):
+            if info["node_id"] == nid:
+                self.dispatched.pop(task_id, None)
+                await self._retry_or_fail(info["spec"], f"node {nid[:8]} died")
+        # Restart/kill its actors.
+        for actor_id, ent in list(self.actors.items()):
+            if ent.node_id == nid and ent.state in ("ALIVE", "PENDING", "RESTARTING"):
+                await self._maybe_restart_actor(actor_id, f"node {nid[:8]} died")
+        # Mark objects whose only copies were there as lost -> owners may
+        # reconstruct from lineage (reference object_recovery_manager.cc:26).
+        dead_addr_host_port = node.address
+        for oid, ent in self.objects.items():
+            if ent.state != "ready" or ent.inline is not None:
+                continue
+            ent.holders = {h for h in ent.holders if h[:2] != dead_addr_host_port[:2] or h[1] != dead_addr_host_port[1]}
+        # PG bundles on the node are lost.
+        for (pgid, idx), b in list(self.pg_bundles.items()):
+            if b["node"] == nid:
+                self.pgs[pgid]["state"] = "RESCHEDULING"
+        self._kick()
+
+    async def _health_loop(self):
+        interval = CONFIG.heartbeat_interval_s
+        timeout = interval * CONFIG.num_heartbeats_timeout
+        while True:
+            await asyncio.sleep(interval)
+            now = time.monotonic()
+            for nid, node in list(self.nodes.items()):
+                if node.alive and node.last_beat and now - node.last_beat > timeout:
+                    await self._node_died(nid)
+
+    # ----------------------------------------------------- placement groups
+    async def _h_create_pg(self, conn, a):
+        pg_id = a["pg_id"]
+        bundles = [ResourceSet(_raw=raw) for raw in a["bundles"]]
+        strategy = a.get("strategy", "PACK")
+        placed = self._place_bundles(bundles, strategy)
+        if placed is None:
+            self.pgs[pg_id] = {"state": "PENDING", "bundles_raw": a["bundles"], "strategy": strategy, "name": a.get("name")}
+            return {"state": "PENDING"}
+        for idx, (nid, rs) in enumerate(placed):
+            self.nodes[nid].available.subtract(rs)
+            self.pg_bundles[(pg_id, idx)] = {"node": nid, "available": rs.copy(), "reserved": rs}
+        self.pgs[pg_id] = {"state": "CREATED", "bundles_raw": a["bundles"], "strategy": strategy, "name": a.get("name")}
+        self._kick()
+        return {"state": "CREATED"}
+
+    def _place_bundles(self, bundles: list[ResourceSet], strategy: str):
+        """2-phase prepare/commit is unnecessary with a central scheduler —
+        placement is atomic here (cf. reference GcsPlacementGroupScheduler)."""
+        avail = {nid: n.available.copy() for nid, n in self.nodes.items() if n.alive}
+        placed: list[tuple[str, ResourceSet]] = []
+        used_nodes: set[str] = set()
+        for rs in bundles:
+            candidates = [nid for nid, av in avail.items() if av.fits(rs)]
+            if strategy in ("STRICT_SPREAD", "SPREAD"):
+                fresh = [nid for nid in candidates if nid not in used_nodes]
+                if strategy == "STRICT_SPREAD":
+                    candidates = fresh
+                elif fresh:
+                    candidates = fresh
+            elif strategy == "STRICT_PACK":
+                if used_nodes:
+                    candidates = [nid for nid in candidates if nid in used_nodes]
+            else:  # PACK: prefer already-used nodes
+                pref = [nid for nid in candidates if nid in used_nodes]
+                if pref:
+                    candidates = pref
+            if not candidates:
+                return None
+            nid = sorted(candidates)[0]
+            avail[nid].subtract(rs)
+            placed.append((nid, rs))
+            used_nodes.add(nid)
+        return placed
+
+    async def _h_pg_wait_ready(self, conn, a):
+        deadline = time.monotonic() + a.get("timeout", 30.0)
+        pg_id = a["pg_id"]
+        while time.monotonic() < deadline:
+            pg = self.pgs.get(pg_id)
+            if pg is None:
+                return {"ready": False, "reason": "removed"}
+            if pg["state"] == "CREATED":
+                return {"ready": True}
+            # Retry placement (nodes may have joined/freed).
+            bundles = [ResourceSet(_raw=raw) for raw in pg["bundles_raw"]]
+            placed = self._place_bundles(bundles, pg["strategy"])
+            if placed is not None:
+                for idx, (nid, rs) in enumerate(placed):
+                    self.nodes[nid].available.subtract(rs)
+                    self.pg_bundles[(pg_id, idx)] = {"node": nid, "available": rs.copy(), "reserved": rs}
+                pg["state"] = "CREATED"
+                self._kick()
+                return {"ready": True}
+            await asyncio.sleep(0.05)
+        return {"ready": False, "reason": "timeout"}
+
+    async def _h_remove_pg(self, conn, a):
+        pg_id = a["pg_id"]
+        self.pgs.pop(pg_id, None)
+        for (pgid, idx) in list(self.pg_bundles):
+            if pgid == pg_id:
+                b = self.pg_bundles.pop((pgid, idx))
+                node = self.nodes.get(b["node"])
+                if node is not None and node.alive:
+                    node.available.add(b["reserved"])
+        self._kick()
+        return {}
+
+    # ------------------------------------------------------------------ KV
+    async def _h_kv_put(self, conn, a):
+        key = (a.get("ns", ""), a["key"])
+        if a.get("overwrite", True) or key not in self.kv:
+            self.kv[key] = a["value"]
+            return {"added": True}
+        return {"added": False}
+
+    async def _h_kv_get(self, conn, a):
+        return {"value": self.kv.get((a.get("ns", ""), a["key"]))}
+
+    async def _h_kv_del(self, conn, a):
+        return {"deleted": self.kv.pop((a.get("ns", ""), a["key"]), None) is not None}
+
+    async def _h_kv_exists(self, conn, a):
+        return {"exists": (a.get("ns", ""), a["key"]) in self.kv}
+
+    async def _h_kv_keys(self, conn, a):
+        ns = a.get("ns", "")
+        prefix = a.get("prefix", "")
+        return {"keys": [k for (n, k) in self.kv if n == ns and k.startswith(prefix)]}
+
+    # ------------------------------------------------------------ state API
+    async def _h_cluster_resources(self, conn, a):
+        total: dict[str, float] = {}
+        avail: dict[str, float] = {}
+        for n in self.nodes.values():
+            if not n.alive:
+                continue
+            for k, v in n.total.to_dict().items():
+                total[k] = total.get(k, 0) + v
+            for k, v in n.available.to_dict().items():
+                avail[k] = avail.get(k, 0) + v
+        return {"total": total, "available": avail}
+
+    async def _h_state_snapshot(self, conn, a):
+        return {
+            "nodes": {
+                nid: {
+                    "alive": n.alive,
+                    "address": n.address,
+                    "total": n.total.to_dict(),
+                    "available": n.available.to_dict(),
+                    "labels": n.labels,
+                }
+                for nid, n in self.nodes.items()
+            },
+            "actors": {
+                aid: {
+                    "state": e.state,
+                    "name": e.name,
+                    "node_id": e.node_id,
+                    "class": e.spec.name,
+                    "restarts_used": e.restarts_used,
+                }
+                for aid, e in self.actors.items()
+            },
+            "pending_tasks": len(self.pending),
+            "dispatched_tasks": len(self.dispatched),
+            "num_objects": len(self.objects),
+            "pgs": {pid: {"state": p["state"], "strategy": p["strategy"]} for pid, p in self.pgs.items()},
+        }
+
+    async def _h_ping(self, conn, a):
+        return {"pong": True, "session_id": self.session_id}

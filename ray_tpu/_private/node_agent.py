@@ -1,0 +1,330 @@
+"""Per-node agent: worker pool, task dispatch, object serving, heartbeats.
+
+Parity target: the reference raylet (src/ray/raylet/raylet.h:33 +
+node_manager.h:122): WorkerPool (worker_pool.h:228 — process prestart and
+reuse), LocalTaskManager dispatch (local_task_manager.cc:124), object serving
+(object_manager.h:106 Push/Pull), heartbeat/health (gcs_health_check_manager).
+Scheduling decisions live in the controller (see controller.py); the agent
+only executes dispatch orders — no local queueing/spillback.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import subprocess
+import sys
+from collections import deque
+from typing import Optional
+
+from ray_tpu._private import rpc
+from ray_tpu._private.ids import WorkerID
+from ray_tpu._private.object_store import LocalStore
+from ray_tpu._private.rtconfig import CONFIG
+from ray_tpu._private.task_spec import ACTOR_CREATE, TaskSpec
+
+logger = logging.getLogger(__name__)
+
+
+class _WorkerSlot:
+    __slots__ = ("worker_id", "proc", "conn", "state", "task_id", "actor_id", "address",
+                 "registered", "dedicated")
+
+    def __init__(self, worker_id: str, proc, dedicated: bool = False):
+        self.worker_id = worker_id
+        self.proc = proc
+        self.conn: Optional[rpc.Connection] = None
+        self.state = "starting"  # starting | idle | reserved | busy | actor | dead
+        self.task_id: Optional[str] = None
+        self.actor_id: Optional[str] = None
+        self.address = None
+        self.registered = asyncio.Event()
+        self.dedicated = dedicated  # spawned for an actor; never joins the pool
+
+
+class NodeAgent:
+    def __init__(
+        self,
+        node_id: str,
+        session_id: str,
+        controller_addr: tuple,
+        resources_raw: dict,
+        labels: dict | None = None,
+        host: str = "127.0.0.1",
+        env: dict | None = None,
+    ):
+        self.node_id = node_id
+        self.session_id = session_id
+        self.controller_addr = controller_addr
+        self.resources_raw = resources_raw
+        self.labels = labels or {}
+        self.host = host
+        self.extra_env = env or {}
+        self.server = rpc.RpcServer(self._on_request, self._on_push, self._on_worker_conn_close)
+        self.store = LocalStore(session_id, CONFIG.object_store_memory_bytes, CONFIG.object_spill_dir, CONFIG.shm_dir)
+        self.controller: Optional[rpc.Connection] = None
+        self.workers: dict[str, _WorkerSlot] = {}
+        self._idle_waiters: deque = None  # set in start
+        self._tasks: list[asyncio.Task] = []
+        self._stopping = False
+        self.port = 0
+
+    async def start(self) -> int:
+        self._idle_waiters = deque()
+        self.port = await self.server.start(self.host, 0)
+        self.controller = await rpc.connect(
+            *self.controller_addr,
+            on_request=self._on_ctrl_request,
+            on_push=self._on_ctrl_push,
+            on_close=lambda c: None if self._stopping else os._exit(1) if os.environ.get("RT_AGENT_STANDALONE") else None,
+        )
+        rep = await self.controller.call(
+            "register",
+            kind="node",
+            node_id=self.node_id,
+            address=(self.host, self.port),
+            resources=self.resources_raw,
+            labels=self.labels,
+        )
+        CONFIG.load_snapshot(rep["config"])
+        self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
+        self._tasks.append(asyncio.ensure_future(self._reap_loop()))
+        if CONFIG.prestart_workers and self.resources_raw.get("CPU", 0) > 0:
+            self._spawn_worker()  # hide first-task process startup latency
+        return self.port
+
+    async def stop(self):
+        self._stopping = True
+        for t in self._tasks:
+            t.cancel()
+        for slot in list(self.workers.values()):
+            self._kill_slot(slot)
+        await self.server.stop()
+        if self.controller is not None:
+            await self.controller.close()
+        self.store.shutdown()
+
+    # -------------------------------------------------- controller channel
+    async def _on_ctrl_request(self, conn, method, a):
+        if method == "dispatch":
+            return await self._dispatch(a["spec"])
+        raise rpc.RpcError(f"agent: unknown ctrl method {method}")
+
+    async def _on_ctrl_push(self, conn, method, a):
+        if method == "free":
+            for oid in a["oids"]:
+                self.store.delete(oid)
+                try:
+                    os.unlink(self.store._path(oid))
+                except FileNotFoundError:
+                    pass
+        elif method == "kill_worker":
+            slot = self.workers.get(a["worker_id"])
+            if slot is not None:
+                self._kill_slot(slot)
+        elif method == "shutdown":
+            await self.stop()
+
+    async def _heartbeat_loop(self):
+        while True:
+            await asyncio.sleep(CONFIG.heartbeat_interval_s)
+            try:
+                await self.controller.push("heartbeat", node_id=self.node_id)
+            except Exception:
+                return
+
+    # ----------------------------------------------------- worker channel
+    async def _on_request(self, conn, method, a):
+        if method == "register_worker":
+            slot = self.workers.get(a["worker_id"])
+            if slot is None:
+                raise rpc.RpcError("unknown worker")
+            slot.conn = conn
+            slot.address = tuple(a["address"])
+            conn.meta["worker_id"] = a["worker_id"]
+            slot.registered.set()
+            if slot.dedicated:
+                slot.state = "reserved"
+            else:
+                self._worker_became_idle(slot)
+            return {"node_id": self.node_id, "config": CONFIG.snapshot()}
+        if method == "fetch_object":
+            mv = self.store.get(a["oid"])
+            if mv is None:
+                return {"found": False}
+            return {"found": True, "data": mv}
+        raise rpc.RpcError(f"agent: unknown method {method}")
+
+    async def _on_push(self, conn, method, a):
+        if method == "worker_idle":
+            slot = self.workers.get(a["worker_id"])
+            if slot is not None and slot.state == "busy":
+                self._worker_became_idle(slot)
+
+    def _on_worker_conn_close(self, conn):
+        wid = conn.meta.get("worker_id")
+        if wid and wid in self.workers:
+            asyncio.ensure_future(self._worker_exited(self.workers[wid], "connection lost"))
+
+    # ---------------------------------------------------------- dispatch
+    async def _dispatch(self, spec: TaskSpec) -> dict:
+        slot = await self._acquire_worker(spec)
+        slot.task_id = spec.task_id
+        if spec.kind == ACTOR_CREATE:
+            slot.state = "actor"
+            slot.actor_id = spec.actor_id
+        else:
+            slot.state = "busy"
+        await slot.conn.push("execute", spec=spec)
+        return {"worker_id": slot.worker_id}
+
+    def _pool_cap(self) -> int:
+        """Max concurrently running pool (non-actor) workers ~ CPU slots
+        (reference WorkerPool keys by resource demand; we cap by node CPUs)."""
+        cpu = self.resources_raw.get("CPU", 0) / CONFIG.resource_unit
+        return max(1, int(cpu))
+
+    async def _acquire_worker(self, spec: TaskSpec) -> _WorkerSlot:
+        # Actors always get a dedicated fresh process (reference: dedicated
+        # workers for actors, worker_pool.cc PopWorker for actor creation).
+        if spec.kind == ACTOR_CREATE:
+            slot = self._spawn_worker(spec.runtime_env, dedicated=True)
+            await asyncio.wait_for(slot.registered.wait(), CONFIG.worker_register_timeout_s)
+            return slot
+        while True:
+            for slot in self.workers.values():
+                if slot.state == "idle":
+                    slot.state = "reserved"
+                    return slot
+            pool_active = sum(
+                1
+                for s in self.workers.values()
+                if not s.dedicated and s.state in ("starting", "reserved", "busy", "idle")
+            )
+            if pool_active < self._pool_cap():
+                self._spawn_worker(spec.runtime_env)
+            fut = asyncio.get_running_loop().create_future()
+            self._idle_waiters.append(fut)
+            await asyncio.wait_for(fut, CONFIG.worker_register_timeout_s)
+
+    def _worker_became_idle(self, slot: _WorkerSlot):
+        slot.state = "idle"
+        slot.task_id = None
+        while self._idle_waiters:
+            fut = self._idle_waiters.popleft()
+            if not fut.done():
+                fut.set_result(None)
+                break
+
+    def _spawn_worker(self, runtime_env: dict | None = None, dedicated: bool = False) -> _WorkerSlot:
+        wid = WorkerID.from_random().hex()
+        env = dict(os.environ)
+        env.update(self.extra_env)
+        # Make sure spawned workers can import ray_tpu wherever the driver ran.
+        import ray_tpu
+
+        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        env.update(
+            RT_WORKER_ID=wid,
+            RT_NODE_ID=self.node_id,
+            RT_SESSION=self.session_id,
+            RT_CONTROLLER=f"{self.controller_addr[0]}:{self.controller_addr[1]}",
+            RT_AGENT=f"{self.host}:{self.port}",
+        )
+        if runtime_env:
+            for k, v in (runtime_env.get("env_vars") or {}).items():
+                env[k] = str(v)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_proc"],
+            env=env,
+            stdout=None,
+            stderr=None,
+        )
+        slot = _WorkerSlot(wid, proc, dedicated=dedicated)
+        self.workers[wid] = slot
+        return slot
+
+    def _kill_slot(self, slot: _WorkerSlot):
+        slot.state = "dead"
+        try:
+            slot.proc.terminate()
+        except Exception:
+            pass
+
+    async def _reap_loop(self):
+        """Detect worker process exits (reference: raylet learns via socket
+        disconnect + waitpid; we poll)."""
+        while True:
+            await asyncio.sleep(0.2)
+            for wid, slot in list(self.workers.items()):
+                if slot.proc.poll() is not None and slot.state != "dead":
+                    await self._worker_exited(slot, f"exit code {slot.proc.returncode}")
+
+    async def _worker_exited(self, slot: _WorkerSlot, reason: str):
+        if slot.state == "dead":
+            self.workers.pop(slot.worker_id, None)
+            return
+        prev_state = slot.state
+        slot.state = "dead"
+        self.workers.pop(slot.worker_id, None)
+        if prev_state in ("busy", "actor") or slot.actor_id:
+            try:
+                await self.controller.push(
+                    "worker_died",
+                    worker_id=slot.worker_id,
+                    task_id=slot.task_id if prev_state == "busy" else None,
+                    actor_id=slot.actor_id,
+                    reason=reason,
+                )
+            except Exception:
+                pass
+
+
+async def run_agent_until_cancelled(agent: NodeAgent):
+    await agent.start()
+    try:
+        while True:
+            await asyncio.sleep(3600)
+    except asyncio.CancelledError:
+        await agent.stop()
+
+
+def main():
+    """Standalone entry: `python -m ray_tpu._private.node_agent` (used by
+    cluster_utils to start extra nodes, and by `ray-tpu start` CLI)."""
+    import argparse
+    import json
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--controller", required=True)
+    p.add_argument("--node-id", required=True)
+    p.add_argument("--session", required=True)
+    p.add_argument("--resources", required=True, help="json fixed-point raw map")
+    p.add_argument("--labels", default="{}")
+    args = p.parse_args()
+    host, port = args.controller.rsplit(":", 1)
+    os.environ["RT_AGENT_STANDALONE"] = "1"
+    logging.basicConfig(level=logging.INFO)
+    agent = NodeAgent(
+        node_id=args.node_id,
+        session_id=args.session,
+        controller_addr=(host, int(port)),
+        resources_raw=json.loads(args.resources),
+        labels=json.loads(args.labels),
+    )
+
+    async def _run():
+        await agent.start()
+        while True:
+            await asyncio.sleep(3600)
+
+    try:
+        asyncio.run(_run())
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

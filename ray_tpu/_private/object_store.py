@@ -1,0 +1,204 @@
+"""Shared-memory object store (plasma-equivalent) with disk spilling.
+
+Parity target: reference object_manager/plasma/ (PlasmaStore store.h:55,
+dlmalloc-on-shm, LRU EvictionPolicy, fallback-to-disk) and
+raylet/local_object_manager.h:42 (spill/restore via external storage,
+python/ray/_private/external_storage.py:72).
+
+TPU-era design: instead of one store daemon with a dlmalloc heap, each object
+is a file-backed mmap in /dev/shm named `rt_{session}_{oid}`. All processes on
+a host share the namespace, so same-host reads attach the segment zero-copy
+(numpy/jax arrays deserialize as views over the mapping via pickle5 oob
+buffers). Cross-host reads go over the RPC object plane and materialize a
+local secondary copy. Over-capacity stores spill LRU segments to disk and
+restore on demand.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import threading
+import time
+
+
+class LocalStore:
+    def __init__(self, session_id: str, capacity_bytes: int, spill_dir: str, shm_dir: str = "/dev/shm"):
+        self.session = session_id[:8]
+        self.capacity = capacity_bytes
+        self.spill_dir = os.path.join(spill_dir, self.session)
+        self.shm_dir = shm_dir
+        self._lock = threading.RLock()
+        # oid -> {"size": int, "where": "shm"|"spill", "last_used": float,
+        #         "mv": memoryview|None, "mm": mmap|None, "created": bool}
+        self._objects: dict[str, dict] = {}
+        self._used = 0
+
+    # -- naming ------------------------------------------------------------
+    def _path(self, oid: str) -> str:
+        return os.path.join(self.shm_dir, f"rt_{self.session}_{oid}")
+
+    def _spill_path(self, oid: str) -> str:
+        return os.path.join(self.spill_dir, oid)
+
+    # -- write -------------------------------------------------------------
+    def put(self, oid: str, parts: list) -> int:
+        """Write a flattened object blob (list of bytes-like) into shm.
+        Returns total size. Idempotent per oid."""
+        total = sum(len(p) for p in parts)
+        with self._lock:
+            if oid in self._objects:
+                return self._objects[oid]["size"]
+            self._maybe_evict(total)
+            path = self._path(oid)
+            fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_TRUNC, 0o600)
+            try:
+                os.ftruncate(fd, max(total, 1))
+                mm = mmap.mmap(fd, max(total, 1))
+            finally:
+                os.close(fd)
+            off = 0
+            for p in parts:
+                mm[off : off + len(p)] = bytes(p) if not isinstance(p, (bytes, bytearray, memoryview)) else p
+                off += len(p)
+            self._objects[oid] = {
+                "size": total,
+                "where": "shm",
+                "last_used": time.monotonic(),
+                "mm": mm,
+                "mv": memoryview(mm)[:total],
+                "created": True,
+            }
+            self._used += total
+            return total
+
+    # -- read --------------------------------------------------------------
+    def get(self, oid: str):
+        """Return a zero-copy memoryview of the blob, or None if absent.
+        Attaches a segment created by another same-host process if needed;
+        restores from spill if the segment was spilled."""
+        with self._lock:
+            ent = self._objects.get(oid)
+            if ent is not None:
+                ent["last_used"] = time.monotonic()
+                if ent["where"] == "shm":
+                    return ent["mv"]
+                return self._restore(oid, ent)
+            # try attach (created by a sibling process on this host)
+            path = self._path(oid)
+            try:
+                fd = os.open(path, os.O_RDONLY)
+            except FileNotFoundError:
+                return None
+            try:
+                size = os.fstat(fd).st_size
+                mm = mmap.mmap(fd, size, prot=mmap.PROT_READ)
+            finally:
+                os.close(fd)
+            self._objects[oid] = {
+                "size": size,
+                "where": "shm",
+                "last_used": time.monotonic(),
+                "mm": mm,
+                "mv": memoryview(mm),
+                "created": False,
+            }
+            return self._objects[oid]["mv"]
+
+    def contains(self, oid: str) -> bool:
+        with self._lock:
+            if oid in self._objects:
+                return True
+            return os.path.exists(self._path(oid))
+
+    # -- spill/restore -----------------------------------------------------
+    def _maybe_evict(self, incoming: int) -> None:
+        if self._used + incoming <= self.capacity:
+            return
+        victims = sorted(
+            (o for o, e in self._objects.items() if e["where"] == "shm" and e["created"]),
+            key=lambda o: self._objects[o]["last_used"],
+        )
+        for oid in victims:
+            if self._used + incoming <= self.capacity:
+                break
+            self._spill(oid)
+
+    def _spill(self, oid: str) -> None:
+        ent = self._objects[oid]
+        os.makedirs(self.spill_dir, exist_ok=True)
+        with open(self._spill_path(oid), "wb") as f:
+            f.write(ent["mv"])
+        self._release_mapping(ent)
+        try:
+            os.unlink(self._path(oid))
+        except FileNotFoundError:
+            pass
+        ent["where"] = "spill"
+        self._used -= ent["size"]
+
+    def _restore(self, oid: str, ent: dict):
+        self._maybe_evict(ent["size"])
+        with open(self._spill_path(oid), "rb") as f:
+            data = f.read()
+        path = self._path(oid)
+        fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_TRUNC, 0o600)
+        try:
+            os.ftruncate(fd, max(len(data), 1))
+            mm = mmap.mmap(fd, max(len(data), 1))
+        finally:
+            os.close(fd)
+        mm[: len(data)] = data
+        ent.update(where="shm", mm=mm, mv=memoryview(mm)[: len(data)], created=True)
+        self._used += ent["size"]
+        try:
+            os.unlink(self._spill_path(oid))
+        except FileNotFoundError:
+            pass
+        return ent["mv"]
+
+    # -- delete ------------------------------------------------------------
+    @staticmethod
+    def _release_mapping(ent: dict) -> None:
+        if ent.get("mv") is not None:
+            try:
+                ent["mv"].release()
+            except BufferError:
+                pass  # a deserialized array still views it; mmap stays alive
+            ent["mv"] = None
+        if ent.get("mm") is not None:
+            try:
+                ent["mm"].close()
+            except BufferError:
+                pass
+            ent["mm"] = None
+
+    def delete(self, oid: str) -> None:
+        with self._lock:
+            ent = self._objects.pop(oid, None)
+            if ent is None:
+                return
+            if ent["where"] == "shm":
+                if ent["created"]:
+                    self._used -= ent["size"]
+                    try:
+                        os.unlink(self._path(oid))
+                    except FileNotFoundError:
+                        pass
+            else:
+                try:
+                    os.unlink(self._spill_path(oid))
+                except FileNotFoundError:
+                    pass
+            self._release_mapping(ent)
+
+    def used_bytes(self) -> int:
+        return self._used
+
+    def num_objects(self) -> int:
+        return len(self._objects)
+
+    def shutdown(self) -> None:
+        with self._lock:
+            for oid in list(self._objects):
+                self.delete(oid)

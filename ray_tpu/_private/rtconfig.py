@@ -1,0 +1,91 @@
+"""Global runtime flag table, env-overridable.
+
+Parity target: reference src/ray/common/ray_config_def.h (224 RAY_CONFIG
+entries, overridden by RAY_<name> env vars or ray.init(_system_config=...)).
+Here: a typed registry; each flag is overridable via env var `RT_<NAME>` or
+`init(_system_config={...})`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable
+
+_REGISTRY: dict[str, tuple[type, Any]] = {}
+
+
+def _flag(name: str, typ: type, default: Any) -> None:
+    _REGISTRY[name] = (typ, default)
+
+
+# --- core timings / limits -------------------------------------------------
+_flag("heartbeat_interval_s", float, 0.5)
+_flag("num_heartbeats_timeout", int, 6)  # node dead after N missed beats
+_flag("task_retry_delay_s", float, 0.05)
+_flag("default_max_task_retries", int, 3)
+_flag("default_max_actor_restarts", int, 0)
+_flag("worker_register_timeout_s", float, 30.0)
+_flag("connect_timeout_s", float, 30.0)
+_flag("rpc_max_frame_bytes", int, 1 << 31)
+# Objects smaller than this are passed inline in RPC messages instead of the
+# shared-memory store (cf. reference max_direct_call_object_size, 100KB).
+_flag("max_inline_object_bytes", int, 100 * 1024)
+# Per-node shared-memory store capacity before spilling to disk.
+_flag("object_store_memory_bytes", int, 2 * 1024 * 1024 * 1024)
+_flag("object_spill_dir", str, "/tmp/ray_tpu/spill")
+_flag("shm_dir", str, "/dev/shm")
+_flag("session_dir", str, "/tmp/ray_tpu")
+_flag("min_workers_per_node", int, 0)
+_flag("prestart_workers", bool, True)
+_flag("idle_worker_keep_s", float, 300.0)
+_flag("scheduler_spread_threshold", float, 0.5)  # hybrid policy pack->spread knob
+_flag("lineage_reconstruction_enabled", bool, True)
+_flag("max_pending_calls_default", int, -1)
+_flag("log_to_driver", bool, True)
+# Fixed-point resource arithmetic granularity (reference fixed_point.h uses 1e-4).
+_flag("resource_unit", int, 10000)
+
+
+class _Config:
+    """Attribute access to flags with env + runtime overrides."""
+
+    def __init__(self):
+        self._overrides: dict[str, Any] = {}
+
+    def apply_system_config(self, overrides: dict[str, Any] | None) -> None:
+        if not overrides:
+            return
+        for k, v in overrides.items():
+            if k not in _REGISTRY:
+                raise ValueError(f"Unknown system config flag: {k}")
+            typ, _ = _REGISTRY[k]
+            self._overrides[k] = typ(v)
+
+    def snapshot(self) -> dict[str, Any]:
+        """Full resolved table — propagated to all nodes at cluster start
+        (cf. reference NodeManager GetSystemConfig node_manager.proto:451)."""
+        return {k: getattr(self, k) for k in _REGISTRY}
+
+    def load_snapshot(self, snap: dict[str, Any]) -> None:
+        self._overrides.update(snap)
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name in self._overrides:
+            return self._overrides[name]
+        if name not in _REGISTRY:
+            raise AttributeError(f"Unknown config flag {name}")
+        typ, default = _REGISTRY[name]
+        env = os.environ.get(f"RT_{name.upper()}")
+        if env is not None:
+            if typ is bool:
+                return env.lower() in ("1", "true", "yes")
+            if typ in (dict, list):
+                return json.loads(env)
+            return typ(env)
+        return default
+
+
+CONFIG = _Config()

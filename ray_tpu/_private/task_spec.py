@@ -1,0 +1,69 @@
+"""Task specification — the unit handed from submitter to scheduler to worker.
+
+Parity target: reference src/ray/common/task/task_spec.h (TaskSpecification)
++ python/ray/includes/function_descriptor.pxi. Functions are registered once
+in the controller KV by id and referenced by hash (cf. reference
+python/ray/_private/function_manager.py export/import via GCS KV).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+NORMAL = "normal"
+ACTOR_CREATE = "actor_create"
+ACTOR_TASK = "actor_task"
+
+
+@dataclass
+class SchedulingStrategy:
+    """DEFAULT (hybrid pack/spread), SPREAD, node affinity, or placement group.
+
+    Parity: reference python/ray/util/scheduling_strategies.py +
+    raylet/scheduling/policy/*."""
+
+    kind: str = "DEFAULT"  # DEFAULT | SPREAD | NODE_AFFINITY | PLACEMENT_GROUP
+    node_id: Optional[str] = None
+    soft: bool = False
+    pg_id: Optional[str] = None
+    pg_bundle_index: int = -1
+    pg_capture_child_tasks: bool = False
+
+
+@dataclass
+class TaskSpec:
+    task_id: str
+    kind: str  # NORMAL | ACTOR_CREATE | ACTOR_TASK
+    name: str
+    # Function: registered blob id in controller KV ("fn:<id>") — workers cache.
+    function_id: str
+    method_name: str = ""  # for actor tasks
+    # Encoded args: list of ("v", header, [bufs]) or ("ref", oid, owner_addr)
+    args: list = field(default_factory=list)
+    kwargs: dict = field(default_factory=dict)
+    num_returns: int = 1
+    resources: dict = field(default_factory=dict)  # raw fixed-point mapping
+    strategy: SchedulingStrategy = field(default_factory=SchedulingStrategy)
+    max_retries: int = 3
+    retry_exceptions: bool = False
+    runtime_env: dict = field(default_factory=dict)
+    # Ownership (cf. reference core_worker TaskManager/ReferenceCounter):
+    owner_id: str = ""  # worker id of submitter
+    owner_addr: Optional[tuple] = None  # (host, port) of owner's RPC server
+    # Actor linkage:
+    actor_id: Optional[str] = None
+    max_restarts: int = 0
+    max_task_retries: int = 0
+    max_concurrency: int = 1
+    actor_name: Optional[str] = None
+    namespace: str = "default"
+    get_if_exists: bool = False
+    # retry bookkeeping (mutated by controller):
+    attempt: int = 0
+
+    def return_object_ids(self) -> list[str]:
+        from ray_tpu._private.ids import ObjectID, TaskID
+
+        tid = TaskID.from_hex(self.task_id)
+        return [ObjectID.for_task_return(tid, i).hex() for i in range(self.num_returns)]

@@ -1,0 +1,705 @@
+"""Per-process client runtime: ownership, objects, task/actor submission.
+
+Parity target: the reference core worker (src/ray/core_worker/core_worker.h:166)
++ its Python face (python/ray/_private/worker.py): TaskManager (task_manager.h:175,
+retries + lineage resubmit cc:313), ReferenceCounter (reference_count.h:72),
+in-process memory store (memory_store.h:45), plasma provider
+(plasma_store_provider.h:93), direct actor transport
+(transport/actor_task_submitter.h:78 — ordered per-caller queues over a direct
+worker connection).
+
+Every process (driver and executing workers alike) hosts one `Worker`:
+an IO event-loop thread, an RPC server (serves `fetch_object` and, on actor
+workers, `actor_call`), a shared-memory LocalStore view, and one connection to
+the controller.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+import time
+import traceback
+from typing import Any, Optional
+
+from ray_tpu import exceptions as exc
+from ray_tpu._private import rpc
+from ray_tpu._private.ids import ObjectID, TaskID, WorkerID
+from ray_tpu._private.object_store import LocalStore
+from ray_tpu._private.resources import ResourceSet
+from ray_tpu._private.rtconfig import CONFIG
+from ray_tpu._private.serialization import (
+    SerializedObject,
+    deserialize,
+    dumps_oob,
+    loads_oob,
+    serialize,
+)
+from ray_tpu._private.task_spec import ACTOR_CREATE, ACTOR_TASK, NORMAL, SchedulingStrategy, TaskSpec
+
+logger = logging.getLogger(__name__)
+
+_MODE_DRIVER = "driver"
+_MODE_WORKER = "worker"
+
+
+class ObjectRef:
+    """A future for an object in the cluster (reference: ObjectRef in
+    python/ray/includes/object_ref.pxi; ownership semantics from
+    reference_count.h:72 — only the owner process refcounts; deserialized
+    copies are borrowed and do not affect lifetime in round 1)."""
+
+    __slots__ = ("_oid", "_owned", "_worker", "__weakref__")
+
+    def __init__(self, oid: str, owned: bool = False, worker: "Worker" = None):
+        self._oid = oid
+        self._owned = owned
+        self._worker = worker
+        if owned and worker is not None:
+            worker._incref(oid)
+
+    def hex(self) -> str:
+        return self._oid
+
+    def binary(self) -> bytes:
+        return bytes.fromhex(self._oid)
+
+    def task_id(self) -> str:
+        return ObjectID.from_hex(self._oid).task_id().hex()
+
+    def __hash__(self):
+        return hash(self._oid)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other._oid == self._oid
+
+    def __repr__(self):
+        return f"ObjectRef({self._oid[:16]})"
+
+    def __del__(self):
+        if self._owned and self._worker is not None:
+            try:
+                self._worker._decref(self._oid)
+            except Exception:
+                pass
+
+    def __reduce__(self):
+        # Plain-pickle fallback (e.g. a ref captured in a closure): the
+        # deserialized copy is a borrowed ref bound to that process's worker.
+        return (_borrowed_ref, (self._oid,))
+
+    def future(self):
+        """concurrent.futures.Future view of this ref."""
+        import concurrent.futures
+
+        f: concurrent.futures.Future = concurrent.futures.Future()
+
+        def _bg():
+            try:
+                f.set_result(self._worker.get([self])[0] if self._worker else None)
+            except Exception as e:
+                f.set_exception(e)
+
+        threading.Thread(target=_bg, daemon=True).start()
+        return f
+
+
+def _borrowed_ref(oid: str) -> ObjectRef:
+    return ObjectRef(oid, owned=False, worker=global_worker())
+
+
+class _Resolution:
+    __slots__ = ("event", "inline", "holders", "error")
+
+    def __init__(self):
+        self.event = threading.Event()
+        self.inline = None
+        self.holders: list = []
+        self.error = None
+
+    def resolve(self, inline, holders, error):
+        self.inline = inline
+        self.holders = holders or []
+        self.error = error
+        self.event.set()
+
+
+_global_worker: Optional["Worker"] = None
+_global_lock = threading.Lock()
+
+
+def global_worker() -> Optional["Worker"]:
+    return _global_worker
+
+
+def set_global_worker(w: Optional["Worker"]):
+    global _global_worker
+    with _global_lock:
+        _global_worker = w
+
+
+class Worker:
+    def __init__(self, mode: str, session_id: str, controller_addr: tuple, node_id: str = "",
+                 agent_addr: tuple | None = None, worker_id: str | None = None):
+        self.mode = mode
+        self.session_id = session_id
+        self.controller_addr = controller_addr
+        self.agent_addr = agent_addr
+        self.node_id = node_id
+        self.worker_id = worker_id or WorkerID.from_random().hex()
+        self.io = rpc.EventLoopThread(name=f"rt-io-{self.worker_id[:6]}")
+        self.server = rpc.RpcServer(self._on_request, self._on_push)
+        self.store = LocalStore(session_id, CONFIG.object_store_memory_bytes,
+                                CONFIG.object_spill_dir, CONFIG.shm_dir)
+        self.controller: Optional[rpc.Connection] = None
+        self.server_addr: tuple = ("", 0)
+        # Owned-object bookkeeping (reference ReferenceCounter):
+        self._refcounts: dict[str, int] = {}
+        self._refcounts_lock = threading.Lock()
+        self._resolutions: dict[str, _Resolution] = {}
+        self._inline_cache: dict[str, list] = {}  # oid -> blob parts (small objs)
+        self._lineage: dict[str, TaskSpec] = {}  # return oid -> producing spec
+        self._registered_fns: set[str] = set()
+        self._fn_cache: dict[str, Any] = {}
+        # Direct actor transport:
+        self._actor_conns: dict[str, rpc.Connection] = {}
+        self._actor_info: dict[str, dict] = {}
+        self._actor_seq: dict[str, int] = {}
+        # Hook used by worker_proc to execute actor calls in-order:
+        self.actor_call_handler = None  # async def (spec) -> reply dict
+        self._shutdown = False
+
+    # ------------------------------------------------------------ lifecycle
+    def connect(self):
+        async def _go():
+            await self.server.start("127.0.0.1", 0)
+            self.server_addr = ("127.0.0.1", self.server.port)
+            self.controller = await rpc.connect(
+                *self.controller_addr,
+                on_push=self._on_ctrl_push,
+                on_close=self._on_ctrl_close,
+            )
+            rep = await self.controller.call(
+                "register", kind="client", worker_id=self.worker_id, address=self.server_addr
+            )
+            CONFIG.load_snapshot(rep["config"])
+
+        self.io.run(_go(), timeout=CONFIG.connect_timeout_s)
+
+    def disconnect(self):
+        self._shutdown = True
+
+        async def _bye():
+            await self.server.stop()
+            if self.controller is not None:
+                await self.controller.close()
+            for c in self._actor_conns.values():
+                await c.close()
+
+        try:
+            self.io.run(_bye(), timeout=5)
+        except Exception:
+            pass
+        self.io.stop()
+        self.store.shutdown()
+        if global_worker() is self:
+            set_global_worker(None)
+
+    def _on_ctrl_close(self, conn):
+        if not self._shutdown and self.mode == _MODE_WORKER:
+            import os
+
+            os._exit(1)  # cluster went away; worker processes die with it
+
+    # --------------------------------------------------------- RPC handlers
+    async def _on_request(self, conn, method, a):
+        if method == "fetch_object":
+            mv = self.store.get(a["oid"])
+            if mv is not None:
+                return {"found": True, "data": mv}
+            parts = self._inline_cache.get(a["oid"])
+            if parts is not None:
+                return {"found": True, "data": b"".join(bytes(p) for p in parts)}
+            return {"found": False}
+        if method == "actor_call":
+            if self.actor_call_handler is None:
+                raise rpc.RpcError("not an actor worker")
+            return await self.actor_call_handler(a["spec"])
+        if method == "health":
+            return {"ok": True}
+        raise rpc.RpcError(f"worker: unknown method {method}")
+
+    async def _on_push(self, conn, method, a):
+        pass
+
+    async def _on_ctrl_push(self, conn, method, a):
+        if method == "object_ready":
+            res = self._resolutions.setdefault(a["oid"], _Resolution())
+            res.resolve(a.get("inline"), [tuple(h) for h in a.get("holders", [])], a.get("error"))
+
+    # ----------------------------------------------------------- refcounts
+    def _incref(self, oid: str):
+        with self._refcounts_lock:
+            self._refcounts[oid] = self._refcounts.get(oid, 0) + 1
+
+    def _decref(self, oid: str):
+        if self._shutdown:
+            return
+        free = False
+        with self._refcounts_lock:
+            n = self._refcounts.get(oid, 0) - 1
+            if n <= 0:
+                self._refcounts.pop(oid, None)
+                free = True
+            else:
+                self._refcounts[oid] = n
+        if free:
+            self._free([oid])
+
+    def _free(self, oids: list[str]):
+        for oid in oids:
+            self._inline_cache.pop(oid, None)
+            self._resolutions.pop(oid, None)
+            self._lineage.pop(oid, None)
+            self.store.delete(oid)
+        try:
+            self.io.spawn(self.controller.push("free_objects", oids=oids))
+        except Exception:
+            pass
+
+    # ----------------------------------------------------------------- put
+    def put(self, value) -> ObjectRef:
+        if isinstance(value, ObjectRef):
+            raise TypeError("Calling put() on an ObjectRef is not allowed.")
+        oid = ObjectID.from_put().hex()
+        sobj = serialize(value, ref_class=ObjectRef)
+        self._store_blob(oid, sobj, register=True)
+        return ObjectRef(oid, owned=True, worker=self)
+
+    def _store_blob(self, oid: str, sobj: SerializedObject, register: bool) -> None:
+        size = sobj.total_bytes()
+        if size <= CONFIG.max_inline_object_bytes:
+            parts = [sobj.to_bytes()]
+            self._inline_cache[oid] = parts
+            if register:
+                self.io.run(self.controller.call(
+                    "register_put", oid=oid, size=size, inline=parts,
+                    holder=self.server_addr, owner=self.worker_id))
+        else:
+            self.store.put(oid, [sobj.to_bytes()])
+            holder = self.agent_addr or self.server_addr
+            if register:
+                self.io.run(self.controller.call(
+                    "register_put", oid=oid, size=size, inline=None,
+                    holder=holder, owner=self.worker_id))
+        res = self._resolutions.setdefault(oid, _Resolution())
+        res.resolve(None, [self.server_addr], None)
+
+    # ----------------------------------------------------------------- get
+    def get(self, refs: list[ObjectRef], timeout: float | None = None) -> list:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        return [self._get_one(r, deadline) for r in refs]
+
+    def _remaining(self, deadline) -> float | None:
+        if deadline is None:
+            return None
+        rem = deadline - time.monotonic()
+        if rem <= 0:
+            raise exc.GetTimeoutError("get() timed out")
+        return rem
+
+    def _get_one(self, ref: ObjectRef, deadline):
+        oid = ref.hex()
+        # 1. local caches (in-process inline / same-host shm, zero-copy)
+        val, found = self._try_local(oid)
+        if found:
+            return val
+        # 2. owned refs: wait for the controller's object_ready push
+        res = self._resolutions.get(oid)
+        if res is not None:
+            if not res.event.wait(timeout=self._remaining(deadline)):
+                raise exc.GetTimeoutError(f"get() timed out on {oid[:16]}")
+            return self._materialize(oid, res.inline, res.holders, res.error, deadline)
+        # 3. borrowed refs: ask the controller directly
+        rep = self.io.run(self.controller.call(
+            "wait_object", oid=oid, timeout=self._remaining(deadline)))
+        if rep["status"] == "timeout":
+            raise exc.GetTimeoutError(f"get() timed out on {oid[:16]}")
+        if rep["status"] == "lost":
+            raise exc.ObjectLostError(f"object {oid[:16]} lost")
+        return self._materialize(oid, rep.get("inline"), [tuple(h) for h in rep.get("holders", [])],
+                                 rep.get("error"), deadline)
+
+    def _try_local(self, oid: str):
+        parts = self._inline_cache.get(oid)
+        if parts is not None:
+            return self._deserialize_blob(memoryview(parts[0]) if len(parts) == 1 else memoryview(b"".join(bytes(p) for p in parts))), True
+        mv = self.store.get(oid)
+        if mv is not None:
+            return self._deserialize_blob(mv), True
+        return None, False
+
+    def _materialize(self, oid: str, inline, holders, error, deadline):
+        if error is not None:
+            raise self._decode_error(error)
+        if inline is not None:
+            blob = inline[0] if len(inline) == 1 else b"".join(bytes(p) for p in inline)
+            self._inline_cache[oid] = [blob]
+            return self._deserialize_blob(memoryview(blob))
+        val, found = self._try_local(oid)
+        if found:
+            return val
+        # remote fetch
+        last_err = None
+        for holder in holders:
+            if tuple(holder) == tuple(self.server_addr):
+                continue
+            try:
+                data = self._fetch_from(tuple(holder), oid, deadline)
+                if data is not None:
+                    self.store.put(oid, [data])
+                    self.io.spawn(self.controller.push(
+                        "add_location", oid=oid,
+                        holder=self.agent_addr or self.server_addr))
+                    mv = self.store.get(oid)
+                    return self._deserialize_blob(mv)
+            except Exception as e:  # holder gone; try next
+                last_err = e
+        # all holders failed -> try lineage reconstruction
+        if self._maybe_reconstruct(oid):
+            return self._get_one(ObjectRef(oid), deadline)
+        raise exc.ObjectLostError(
+            f"object {oid[:16]} unavailable (holders {holders}): {last_err}")
+
+    def _fetch_from(self, holder: tuple, oid: str, deadline):
+        async def _f():
+            conn = await rpc.connect(*holder, timeout=5)
+            try:
+                rep = await conn.call("fetch_object", oid=oid)
+            finally:
+                await conn.close()
+            return rep
+
+        rep = self.io.run(_f(), timeout=self._remaining(deadline))
+        if rep.get("found"):
+            return rep["data"]
+        return None
+
+    def _maybe_reconstruct(self, oid: str) -> bool:
+        """Lineage reconstruction: resubmit the producing task (reference
+        object_recovery_manager.cc:26 RecoverObject)."""
+        if not CONFIG.lineage_reconstruction_enabled:
+            return False
+        spec = self._lineage.get(oid)
+        if spec is None:
+            return False
+        logger.warning("reconstructing %s via task %s", oid[:12], spec.name)
+        self._resolutions[oid] = _Resolution()
+        spec.attempt += 1
+        self.io.run(self.controller.call("submit_task", spec=spec))
+        return True
+
+    def _deserialize_blob(self, mv):
+        return self._deser_with_refs(SerializedObject.from_buffer(mv))
+
+    def _deser_with_refs(self, sobj: SerializedObject):
+        # contained_refs are ObjectRef instances (fresh from serialize()) or
+        # oid hex strings (parsed from a flattened blob) — re-hydrate either.
+        refs = [
+            r if isinstance(r, ObjectRef) else ObjectRef(r, owned=False, worker=self)
+            for r in sobj.contained_refs
+        ]
+        return deserialize(sobj, resolve_ref=lambda idx: refs[idx])
+
+    def _decode_error(self, error_parts) -> Exception:
+        blob = loads_oob(bytes(error_parts[0]), [memoryview(p) for p in error_parts[1:]])
+        etype = blob.get("type")
+        if etype == "TaskError":
+            cause = None
+            if blob.get("cause") is not None:
+                try:
+                    cause = loads_oob(bytes(blob["cause"]), [])
+                except Exception:
+                    cause = None
+            err = exc.TaskError(blob.get("function_name", "?"), blob.get("traceback", ""), cause)
+            if cause is not None and isinstance(cause, Exception):
+                err.__cause__ = cause
+            return err
+        if etype == "WorkerCrashedError":
+            return exc.WorkerCrashedError(blob.get("message", ""))
+        if etype == "ActorDiedError":
+            return exc.ActorDiedError(blob.get("message", ""))
+        if etype == "TaskCancelledError":
+            return exc.RayTpuError(f"task cancelled: {blob.get('message', '')}")
+        return exc.RayTpuError(str(blob))
+
+    # ---------------------------------------------------------------- wait
+    def wait(self, refs: list[ObjectRef], num_returns: int = 1, timeout: float | None = None):
+        if num_returns > len(refs):
+            raise ValueError("num_returns > len(refs)")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        pending = list(refs)
+        ready: list[ObjectRef] = []
+        while True:
+            still = []
+            for r in pending:
+                if self._is_ready_local(r.hex()):
+                    ready.append(r)
+                else:
+                    still.append(r)
+            pending = still
+            if len(ready) >= num_returns or not pending:
+                break
+            if pending:
+                oids = [r.hex() for r in pending]
+                rep = self.io.run(self.controller.call("check_objects", oids=oids))
+                newly = [r for r, ok in zip(pending, rep["ready"]) if ok]
+                ready.extend(newly)
+                pending = [r for r, ok in zip(pending, rep["ready"]) if not ok]
+            if len(ready) >= num_returns or not pending:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            time.sleep(0.005)
+        return ready, pending
+
+    def _is_ready_local(self, oid: str) -> bool:
+        if oid in self._inline_cache or self.store.contains(oid):
+            return True
+        res = self._resolutions.get(oid)
+        return res is not None and res.event.is_set()
+
+    # --------------------------------------------------------- submit task
+    def _register_function(self, fn) -> str:
+        blob = serialize(fn, ref_class=ObjectRef)
+        if blob.contained_refs:
+            raise ValueError("remote function may not close over ObjectRefs; pass them as args")
+        data = blob.to_bytes()
+        import hashlib
+
+        fid = hashlib.sha1(data).hexdigest()
+        if fid not in self._registered_fns:
+            self.io.run(self.controller.call("kv_put", ns="fn", key=fid, value=data, overwrite=False))
+            self._registered_fns.add(fid)
+        return fid
+
+    def load_function(self, fid: str):
+        fn = self._fn_cache.get(fid)
+        if fn is None:
+            rep = self.io.run(self.controller.call("kv_get", ns="fn", key=fid))
+            if rep["value"] is None:
+                raise exc.RayTpuError(f"function {fid} not found in KV")
+            sobj = SerializedObject.from_buffer(memoryview(rep["value"]))
+            fn = self._deser_with_refs(sobj)
+            self._fn_cache[fid] = fn
+        return fn
+
+    def _encode_args(self, args, kwargs):
+        enc_args = [self._encode_one(a) for a in args]
+        enc_kwargs = {k: self._encode_one(v) for k, v in kwargs.items()}
+        return enc_args, enc_kwargs
+
+    def _encode_one(self, value):
+        if isinstance(value, ObjectRef):
+            return ("ref", value.hex())
+        sobj = serialize(value, ref_class=ObjectRef)
+        if sobj.total_bytes() <= CONFIG.max_inline_object_bytes:
+            return ("v", sobj.to_bytes())
+        # Large argument: promote to an owned object (reference puts >100KB
+        # args in plasma — remote_function.py _remote).
+        oid = ObjectID.from_put().hex()
+        self._store_blob(oid, sobj, register=True)
+        self._incref(oid)  # pinned for the duration of the session put
+        return ("ref", oid)
+
+    def decode_args(self, enc_args, enc_kwargs):
+        args = [self._decode_one(e) for e in enc_args]
+        kwargs = {k: self._decode_one(e) for k, e in enc_kwargs.items()}
+        return args, kwargs
+
+    def _decode_one(self, e):
+        kind = e[0]
+        if kind == "ref":
+            return self._get_one(ObjectRef(e[1]), deadline=None)
+        return self._deserialize_blob(memoryview(e[1]))
+
+    def submit_task(self, fn, args, kwargs, *, name=None, num_returns=1, resources: ResourceSet,
+                    strategy: SchedulingStrategy | None = None, max_retries: int | None = None,
+                    retry_exceptions=False, runtime_env=None) -> list[ObjectRef]:
+        fid = self._register_function(fn)
+        enc_args, enc_kwargs = self._encode_args(args, kwargs)
+        task_id = TaskID.from_random().hex()
+        spec = TaskSpec(
+            task_id=task_id,
+            kind=NORMAL,
+            name=name or getattr(fn, "__name__", "task"),
+            function_id=fid,
+            args=enc_args,
+            kwargs=enc_kwargs,
+            num_returns=num_returns,
+            resources=resources.raw(),
+            strategy=strategy or SchedulingStrategy(),
+            max_retries=CONFIG.default_max_task_retries if max_retries is None else max_retries,
+            retry_exceptions=retry_exceptions,
+            runtime_env=runtime_env or {},
+            owner_id=self.worker_id,
+            owner_addr=self.server_addr,
+        )
+        refs = []
+        for oid in spec.return_object_ids():
+            self._resolutions[oid] = _Resolution()
+            if spec.max_retries != 0:
+                self._lineage[oid] = spec
+            refs.append(ObjectRef(oid, owned=True, worker=self))
+        self.io.run(self.controller.call("submit_task", spec=spec))
+        return refs
+
+    # -------------------------------------------------------------- actors
+    def create_actor(self, cls, args, kwargs, *, name=None, namespace="default",
+                     get_if_exists=False, resources: ResourceSet,
+                     strategy: SchedulingStrategy | None = None, max_restarts=0,
+                     max_task_retries=0, max_concurrency=1, runtime_env=None,
+                     actor_display_name=None) -> str:
+        from ray_tpu._private.ids import ActorID
+
+        fid = self._register_function(cls)
+        enc_args, enc_kwargs = self._encode_args(args, kwargs)
+        actor_id = ActorID.from_random().hex()
+        spec = TaskSpec(
+            task_id=TaskID.from_random().hex(),
+            kind=ACTOR_CREATE,
+            name=actor_display_name or getattr(cls, "__name__", "actor"),
+            function_id=fid,
+            args=enc_args,
+            kwargs=enc_kwargs,
+            num_returns=0,
+            resources=resources.raw(),
+            strategy=strategy or SchedulingStrategy(),
+            runtime_env=runtime_env or {},
+            owner_id=self.worker_id,
+            owner_addr=self.server_addr,
+            actor_id=actor_id,
+            max_restarts=max_restarts,
+            max_task_retries=max_task_retries,
+            max_concurrency=max_concurrency,
+            actor_name=name,
+            namespace=namespace,
+            get_if_exists=get_if_exists,
+        )
+        rep = self.io.run(self.controller.call("create_actor", spec=spec))
+        return rep["actor_id"]
+
+    async def _a_resolve_actor(self, actor_id: str, wait=True, timeout=60.0) -> dict:
+        info = self._actor_info.get(actor_id)
+        if info is not None and info.get("state") == "ALIVE":
+            return info
+        rep = await self.controller.call(
+            "get_actor_info", actor_id=actor_id, wait=wait, timeout=timeout)
+        if rep["status"] != "ok":
+            raise exc.ActorDiedError(f"actor {actor_id[:12]} not found")
+        if rep["state"] == "DEAD":
+            if rep.get("death_cause"):
+                raise self._decode_error(rep["death_cause"])
+            raise exc.ActorDiedError(f"actor {actor_id[:12]} is dead")
+        self._actor_info[actor_id] = rep
+        return rep
+
+    async def _a_actor_conn(self, actor_id: str) -> rpc.Connection:
+        conn = self._actor_conns.get(actor_id)
+        if conn is not None and not conn.closed:
+            return conn
+        info = await self._a_resolve_actor(actor_id)
+        if info.get("address") is None:
+            raise exc.ActorUnavailableError(f"actor {actor_id[:12]} has no address")
+        conn = await rpc.connect(*info["address"], timeout=10)
+        self._actor_conns[actor_id] = conn
+        return conn
+
+    def submit_actor_task(self, actor_id: str, method_name: str, args, kwargs, *,
+                          num_returns=1, name=None, max_task_retries=0) -> list[ObjectRef]:
+        enc_args, enc_kwargs = self._encode_args(args, kwargs)
+        task_id = TaskID.from_random().hex()
+        spec = TaskSpec(
+            task_id=task_id,
+            kind=ACTOR_TASK,
+            name=name or method_name,
+            function_id="",
+            method_name=method_name,
+            args=enc_args,
+            kwargs=enc_kwargs,
+            num_returns=num_returns,
+            owner_id=self.worker_id,
+            owner_addr=self.server_addr,
+            actor_id=actor_id,
+        )
+        refs = []
+        for oid in spec.return_object_ids():
+            self._resolutions[oid] = _Resolution()
+            refs.append(ObjectRef(oid, owned=True, worker=self))
+        self.io.spawn(self._a_send_actor_call(actor_id, spec, max(0, max_task_retries)))
+        return refs
+
+    async def _a_send_actor_call(self, actor_id: str, spec: TaskSpec, retries_left: int):
+        """Direct actor call with transparent retry across actor restarts
+        (reference ActorTaskSubmitter: queued calls resubmitted on restart
+        when max_task_retries allows)."""
+        connect_attempts = 0
+        while True:
+            try:
+                conn = await self._a_actor_conn(actor_id)
+            except (exc.ActorError, exc.TaskError) as e:
+                self._fail_actor_call(spec, e)
+                return
+            except Exception as e:
+                # Stale address or refused connection: re-resolve a few times
+                # (the actor may be mid-restart and not yet re-registered).
+                self._actor_conns.pop(actor_id, None)
+                self._actor_info.pop(actor_id, None)
+                connect_attempts += 1
+                if connect_attempts <= 20:
+                    await asyncio.sleep(0.1)
+                    continue
+                self._fail_actor_call(spec, e)
+                return
+            try:
+                rep = await conn.call("actor_call", spec=spec)
+            except Exception:
+                self._actor_conns.pop(actor_id, None)
+                self._actor_info.pop(actor_id, None)
+                if retries_left > 0:
+                    retries_left -= 1
+                    await asyncio.sleep(CONFIG.task_retry_delay_s)
+                    continue
+                self._fail_actor_call(
+                    spec, exc.ActorDiedError(f"actor {actor_id[:12]} died mid-call"))
+                return
+            self._apply_actor_reply(spec, rep)
+            return
+
+    def _fail_actor_call(self, spec: TaskSpec, e: Exception):
+        h, bufs = dumps_oob({"type": "ActorDiedError", "message": str(e)})
+        for oid in spec.return_object_ids():
+            res = self._resolutions.setdefault(oid, _Resolution())
+            res.resolve(None, [], [h, *bufs])
+
+    def _apply_actor_reply(self, spec: TaskSpec, rep: dict):
+        error = rep.get("error")
+        for oid, inline, size, holder in rep.get("results", []):
+            res = self._resolutions.setdefault(oid, _Resolution())
+            res.resolve(inline, [tuple(holder)] if holder else [], error)
+
+    def kill_actor(self, actor_id: str, no_restart=True):
+        self.io.run(self.controller.call("kill_actor", actor_id=actor_id, no_restart=no_restart))
+        self._actor_conns.pop(actor_id, None)
+        self._actor_info.pop(actor_id, None)
+
+    # ------------------------------------------------------------- cluster
+    def cluster_resources(self) -> dict:
+        return self.io.run(self.controller.call("cluster_resources"))
+
+    def state_snapshot(self) -> dict:
+        return self.io.run(self.controller.call("state_snapshot"))
+
+    def kv(self, op: str, **kw):
+        return self.io.run(self.controller.call(f"kv_{op}", **kw))

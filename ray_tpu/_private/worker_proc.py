@@ -1,0 +1,230 @@
+"""Worker process entrypoint: executes tasks and hosts actors.
+
+Parity target: the reference's task execution path — TaskReceiver
+(core_worker/transport/task_receiver.h:51) + the Cython callback chain
+(_raylet.pyx:2268 task_execution_handler ->
+execute_task_with_cancellation_handler :2078): deserialize args, run the user
+function, serialize/store returns (small inline, large to the shm store).
+Actor calls arrive directly from callers on this process's RPC server
+(reference direct actor transport) and execute in arrival order on the single
+execution thread (reference sequential_actor_submit_queue.h).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import queue
+import sys
+import threading
+import traceback
+
+from ray_tpu._private import rpc
+from ray_tpu._private.rtconfig import CONFIG
+from ray_tpu._private.serialization import dumps_oob, serialize
+from ray_tpu._private.task_spec import ACTOR_CREATE, ACTOR_TASK, NORMAL, TaskSpec
+from ray_tpu._private.worker import ObjectRef, Worker, set_global_worker
+
+logger = logging.getLogger(__name__)
+
+
+class WorkerProc:
+    def __init__(self):
+        self.worker_id = os.environ["RT_WORKER_ID"]
+        self.node_id = os.environ["RT_NODE_ID"]
+        self.session = os.environ["RT_SESSION"]
+        chost, cport = os.environ["RT_CONTROLLER"].rsplit(":", 1)
+        ahost, aport = os.environ["RT_AGENT"].rsplit(":", 1)
+        self.agent_addr = (ahost, int(aport))
+        self.worker = Worker(
+            mode="worker",
+            session_id=self.session,
+            controller_addr=(chost, int(cport)),
+            node_id=self.node_id,
+            agent_addr=self.agent_addr,
+            worker_id=self.worker_id,
+        )
+        self.exec_queue: "queue.Queue" = queue.Queue()
+        self.agent_conn: rpc.Connection | None = None
+        self.actor_instance = None
+        self.actor_id: str | None = None
+        self._running = True
+
+    # ------------------------------------------------------------ startup
+    def start(self):
+        self.worker.connect()
+        set_global_worker(self.worker)
+        self.worker.actor_call_handler = self._handle_actor_call
+
+        async def _join_agent():
+            self.agent_conn = await rpc.connect(
+                *self.agent_addr,
+                on_push=self._on_agent_push,
+                on_close=lambda c: os._exit(0) if self._running else None,
+            )
+            await self.agent_conn.call(
+                "register_worker", worker_id=self.worker_id, address=self.worker.server_addr
+            )
+
+        self.worker.io.run(_join_agent(), timeout=CONFIG.connect_timeout_s)
+
+    async def _on_agent_push(self, conn, method, a):
+        if method == "execute":
+            self.exec_queue.put(("task", a["spec"], None))
+        elif method == "exit":
+            self._running = False
+            self.exec_queue.put(("exit", None, None))
+
+    async def _handle_actor_call(self, spec: TaskSpec):
+        """Called on the IO thread for direct actor calls; bridges to the
+        execution thread and awaits the reply."""
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self.exec_queue.put(("actor_task", spec, (loop, fut)))
+        return await fut
+
+    # ---------------------------------------------------------- exec loop
+    def run(self):
+        while self._running:
+            kind, spec, reply_slot = self.exec_queue.get()
+            if kind == "exit":
+                break
+            try:
+                if spec.kind == ACTOR_TASK:
+                    reply = self._execute_actor_task(spec)
+                    loop, fut = reply_slot
+                    loop.call_soon_threadsafe(
+                        lambda f=fut, r=reply: f.set_result(r) if not f.done() else None
+                    )
+                else:
+                    self._execute_task(spec)
+            except BaseException:
+                traceback.print_exc()
+        self.worker.disconnect()
+
+    # ---------------------------------------------------------- execution
+    def _package_results(self, spec: TaskSpec, value, error_blob):
+        """Serialize return values: small inline, large into the node shm
+        store with the agent as the advertised holder (it outlives workers)."""
+        results = []
+        oids = spec.return_object_ids()
+        if error_blob is not None:
+            for oid in oids:
+                results.append((oid, None, 0, None))
+            return results
+        if spec.num_returns == 0:
+            return results
+        values = [value] if spec.num_returns == 1 else list(value)
+        if spec.num_returns > 1 and len(values) != spec.num_returns:
+            raise ValueError(
+                f"task {spec.name} declared num_returns={spec.num_returns} "
+                f"but returned {len(values)} values"
+            )
+        for oid, v in zip(oids, values):
+            sobj = serialize(v, ref_class=ObjectRef)
+            size = sobj.total_bytes()
+            blob = sobj.to_bytes()
+            if size <= CONFIG.max_inline_object_bytes:
+                results.append((oid, [blob], size, None))
+            else:
+                self.worker.store.put(oid, [blob])
+                results.append((oid, None, size, self.agent_addr))
+        return results
+
+    def _make_error_blob(self, spec: TaskSpec, e: BaseException):
+        tb = traceback.format_exc()
+        cause_header = None
+        try:
+            cause_header, cause_bufs = dumps_oob(e)
+            if cause_bufs:
+                cause_header = None  # keep error blobs simple: no oob bufs
+        except Exception:
+            cause_header = None
+        h, bufs = dumps_oob(
+            {
+                "type": "TaskError",
+                "function_name": spec.name,
+                "traceback": tb,
+                "cause": cause_header,
+            }
+        )
+        return [h, *bufs]
+
+    def _execute_task(self, spec: TaskSpec):
+        error_blob = None
+        value = None
+        if spec.runtime_env.get("env_vars"):
+            os.environ.update({k: str(v) for k, v in spec.runtime_env["env_vars"].items()})
+        try:
+            if spec.kind == ACTOR_CREATE:
+                cls = self.worker.load_function(spec.function_id)
+                args, kwargs = self.worker.decode_args(spec.args, spec.kwargs)
+                self.actor_instance = cls(*args, **kwargs)
+                self.actor_id = spec.actor_id
+            else:
+                fn = self.worker.load_function(spec.function_id)
+                args, kwargs = self.worker.decode_args(spec.args, spec.kwargs)
+                value = fn(*args, **kwargs)
+        except BaseException as e:  # noqa: BLE001 — user code may raise anything
+            error_blob = self._make_error_blob(spec, e)
+            if spec.kind == ACTOR_CREATE:
+                logger.error("actor __init__ failed:\n%s", traceback.format_exc())
+        try:
+            results = self._package_results(spec, value, error_blob)
+        except BaseException as e:
+            error_blob = self._make_error_blob(spec, e)
+            results = self._package_results(spec, None, error_blob)
+
+        async def _report():
+            payload = dict(task_id=spec.task_id, results=results, error=error_blob, spec=None)
+            if spec.kind == ACTOR_CREATE:
+                payload["actor_address"] = self.worker.server_addr
+            await self.worker.controller.push("task_done", **payload)
+            if spec.kind == NORMAL:
+                await self.agent_conn.push("worker_idle", worker_id=self.worker_id)
+
+        self.worker.io.run(_report())
+
+    def _execute_actor_task(self, spec: TaskSpec) -> dict:
+        error_blob = None
+        value = None
+        try:
+            if self.actor_instance is None:
+                raise RuntimeError("actor instance not initialized")
+            method = getattr(self.actor_instance, spec.method_name)
+            args, kwargs = self.worker.decode_args(spec.args, spec.kwargs)
+            value = method(*args, **kwargs)
+        except BaseException as e:  # noqa: BLE001
+            error_blob = self._make_error_blob(spec, e)
+        try:
+            results = self._package_results(spec, value, error_blob)
+        except BaseException as e:
+            error_blob = self._make_error_blob(spec, e)
+            results = self._package_results(spec, None, error_blob)
+
+        # Advertise results to the controller (async push) so refs passed on
+        # to third parties resolve; the caller gets them in the reply already.
+        async def _advertise():
+            for oid, inline, size, holder in results:
+                await self.worker.controller.push(
+                    "register_put", oid=oid, size=size, inline=inline,
+                    holder=holder, owner=spec.owner_id, error=error_blob)
+
+        if results:
+            self.worker.io.spawn(_advertise())
+        return {"results": results, "error": error_blob}
+
+
+def main():
+    logging.basicConfig(level=logging.INFO, format=f"[worker %(process)d] %(message)s")
+    proc = WorkerProc()
+    proc.start()
+    try:
+        proc.run()
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,142 @@
+"""Actors: stateful remote workers.
+
+Parity target: reference python/ray/actor.py (ActorClass:617,
+ActorClass._remote:907, ActorHandle:1287, ActorMethod:116) — named actors,
+max_restarts, get_if_exists; handles pickle across processes and re-resolve
+via the controller (reference: actor table in GCS, gcs_actor_manager).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ray_tpu._private.resources import normalize_resources
+from ray_tpu._private.task_spec import SchedulingStrategy
+from ray_tpu._private.worker import global_worker
+from ray_tpu.remote_function import _to_strategy
+
+_ACTOR_OPTION_KEYS = {
+    "num_cpus", "num_tpus", "num_gpus", "resources", "memory", "name", "namespace",
+    "get_if_exists", "max_restarts", "max_task_retries", "max_concurrency",
+    "scheduling_strategy", "lifetime", "runtime_env", "placement_group",
+    "placement_group_bundle_index",
+}
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def options(self, num_returns: int = 1):
+        return ActorMethod(self._handle, self._name, num_returns)
+
+    def remote(self, *args, **kwargs):
+        w = global_worker()
+        refs = w.submit_actor_task(
+            self._handle._actor_id,
+            self._name,
+            args,
+            kwargs,
+            num_returns=self._num_returns,
+            max_task_retries=self._handle._max_task_retries,
+        )
+        return refs[0] if self._num_returns == 1 else refs
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(f"Actor method {self._name!r} must be called with .remote().")
+
+
+class ActorHandle:
+    def __init__(self, actor_id: str, max_task_retries: int = 0):
+        self._actor_id = actor_id
+        self._max_task_retries = max_task_retries
+
+    def __getattr__(self, name: str) -> ActorMethod:
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name)
+
+    def __repr__(self):
+        return f"ActorHandle({self._actor_id[:12]})"
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._max_task_retries))
+
+    def __hash__(self):
+        return hash(self._actor_id)
+
+    def __eq__(self, other):
+        return isinstance(other, ActorHandle) and other._actor_id == self._actor_id
+
+
+class ActorClass:
+    def __init__(self, cls, options: dict[str, Any] | None = None):
+        self._cls = cls
+        self._options = dict(options or {})
+
+    def options(self, **overrides) -> "ActorClass":
+        bad = set(overrides) - _ACTOR_OPTION_KEYS
+        if bad:
+            raise ValueError(f"Unknown actor options: {sorted(bad)}")
+        merged = dict(self._options)
+        merged.update(overrides)
+        return ActorClass(self._cls, merged)
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        w = global_worker()
+        if w is None:
+            raise RuntimeError("ray_tpu.init() must be called before .remote()")
+        o = self._options
+        num_tpus = o.get("num_tpus", o.get("num_gpus"))
+        resources = normalize_resources(
+            num_cpus=o.get("num_cpus"),
+            num_tpus=num_tpus,
+            resources=o.get("resources"),
+            memory=o.get("memory"),
+            default_cpus=1.0,
+        )
+        strategy = _to_strategy(o.get("scheduling_strategy"))
+        pg = o.get("placement_group")
+        if pg is not None:
+            strategy = SchedulingStrategy(
+                kind="PLACEMENT_GROUP",
+                pg_id=pg.id if hasattr(pg, "id") else pg,
+                pg_bundle_index=o.get("placement_group_bundle_index", -1),
+            )
+        actor_id = w.create_actor(
+            self._cls,
+            args,
+            kwargs,
+            name=o.get("name"),
+            namespace=o.get("namespace", "default"),
+            get_if_exists=o.get("get_if_exists", False),
+            resources=resources,
+            strategy=strategy,
+            max_restarts=o.get("max_restarts", 0),
+            max_task_retries=o.get("max_task_retries", 0),
+            max_concurrency=o.get("max_concurrency", 1),
+            runtime_env=o.get("runtime_env"),
+            actor_display_name=self._cls.__name__,
+        )
+        return ActorHandle(actor_id, max_task_retries=o.get("max_task_retries", 0))
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class {self._cls.__name__!r} cannot be instantiated directly; "
+            f"use {self._cls.__name__}.remote()."
+        )
+
+
+def get_actor(name: str, namespace: str = "default") -> ActorHandle:
+    w = global_worker()
+    rep = w.io.run(w.controller.call("get_actor_info", name=name, namespace=namespace, wait=False))
+    if rep["status"] != "ok":
+        raise ValueError(f"Failed to look up actor {name!r} in namespace {namespace!r}")
+    return ActorHandle(rep["actor_id"], max_task_retries=rep.get("max_task_retries", 0))
+
+
+def kill(actor: ActorHandle, *, no_restart: bool = True):
+    w = global_worker()
+    w.kill_actor(actor._actor_id, no_restart=no_restart)

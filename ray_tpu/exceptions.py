@@ -1,0 +1,75 @@
+"""Exception hierarchy for the ray_tpu runtime.
+
+Parity target: ray/exceptions.py in the reference (RayError, RayTaskError,
+RayActorError, ObjectLostError, GetTimeoutError, ...). Re-designed minimal set
+for the TPU-native runtime.
+"""
+
+from __future__ import annotations
+
+
+class RayTpuError(Exception):
+    """Base class for all ray_tpu runtime errors."""
+
+
+class TaskError(RayTpuError):
+    """Wraps an exception raised inside a remote task/actor method.
+
+    Mirrors the reference's RayTaskError (python/ray/exceptions.py): the remote
+    traceback is captured as text and re-raised at `get()` on the caller.
+    """
+
+    def __init__(self, function_name: str, traceback_str: str, cause: Exception | None = None):
+        self.function_name = function_name
+        self.traceback_str = traceback_str
+        self.cause = cause
+        super().__init__(
+            f"Remote task {function_name!r} failed:\n{traceback_str}"
+        )
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker process executing the task died unexpectedly."""
+
+
+class ActorError(RayTpuError):
+    """Base for actor-related failures."""
+
+
+class ActorDiedError(ActorError):
+    """The actor is dead (crashed, killed, or out of restarts).
+
+    Parity: reference RayActorError / ActorDiedError.
+    """
+
+
+class ActorUnavailableError(ActorError):
+    """The actor is temporarily unreachable (e.g. restarting)."""
+
+
+class ObjectLostError(RayTpuError):
+    """Object's value was lost (all copies gone) and could not be reconstructed."""
+
+
+class ObjectReconstructionError(ObjectLostError):
+    """Lineage reconstruction failed (e.g. non-retryable parent task)."""
+
+
+class OwnerDiedError(ObjectLostError):
+    """The owner process of this object died, so the object is unrecoverable."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """`get()` timed out."""
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    """Setting up the runtime environment for a task/actor failed."""
+
+
+class PendingCallsLimitExceeded(RayTpuError):
+    """Actor's pending call queue exceeded max_pending_calls."""
+
+
+class NodeDiedError(RayTpuError):
+    """The node hosting the resource died."""

@@ -1,0 +1,48 @@
+"""Shared fixtures.
+
+Parity target: reference python/ray/tests/conftest.py (ray_start_regular:580,
+shutdown_only:497, ray_start_cluster:668). Sharding tests run on a virtual
+8-device CPU mesh (xla_force_host_platform_device_count), the load-bearing
+mechanism for testing multi-chip SPMD without TPU hardware.
+"""
+
+import os
+
+# Must be set before jax import anywhere in the test process tree.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = (
+    "--xla_force_host_platform_device_count=8 " + os.environ.get("XLA_FLAGS", "")
+)
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import pytest  # noqa: E402
+
+import ray_tpu  # noqa: E402
+
+
+@pytest.fixture
+def shutdown_only():
+    yield None
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_start_2cpu(shutdown_only):
+    ray_tpu.init(num_cpus=2)
+    yield
+
+
+@pytest.fixture
+def ray_start_4cpu(shutdown_only):
+    ray_tpu.init(num_cpus=4)
+    yield
+
+
+@pytest.fixture
+def ray_start_cluster():
+    from ray_tpu.cluster_utils import Cluster
+
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    yield cluster
+    ray_tpu.shutdown()
+    cluster.shutdown()

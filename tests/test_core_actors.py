@@ -1,0 +1,179 @@
+"""Actors (parity: reference python/ray/tests/test_actor*.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import ActorDiedError, TaskError
+
+
+def test_counter_actor(ray_start_2cpu):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.n = start
+
+        def inc(self, k=1):
+            self.n += k
+            return self.n
+
+        def value(self):
+            return self.n
+
+    c = Counter.remote(10)
+    assert ray_tpu.get(c.inc.remote(), timeout=30) == 11
+    assert ray_tpu.get(c.inc.remote(5), timeout=30) == 16
+    assert ray_tpu.get(c.value.remote(), timeout=30) == 16
+
+
+def test_actor_calls_ordered(ray_start_2cpu):
+    @ray_tpu.remote
+    class Appender:
+        def __init__(self):
+            self.log = []
+
+        def add(self, x):
+            self.log.append(x)
+            return list(self.log)
+
+    a = Appender.remote()
+    refs = [a.add.remote(i) for i in range(10)]
+    final = ray_tpu.get(refs[-1], timeout=30)
+    assert final == list(range(10))
+
+
+def test_named_actor_and_get_actor(ray_start_2cpu):
+    @ray_tpu.remote
+    class Store:
+        def __init__(self):
+            self.d = {}
+
+        def set(self, k, v):
+            self.d[k] = v
+            return True
+
+        def get(self, k):
+            return self.d.get(k)
+
+    s = Store.options(name="kvstore").remote()
+    assert ray_tpu.get(s.set.remote("a", 1), timeout=30)
+    s2 = ray_tpu.get_actor("kvstore")
+    assert ray_tpu.get(s2.get.remote("a"), timeout=30) == 1
+
+
+def test_get_if_exists(ray_start_2cpu):
+    @ray_tpu.remote
+    class Single:
+        def ping(self):
+            return "pong"
+
+    a = Single.options(name="single", get_if_exists=True).remote()
+    b = Single.options(name="single", get_if_exists=True).remote()
+    assert a._actor_id == b._actor_id
+
+
+def test_actor_method_exception(ray_start_2cpu):
+    @ray_tpu.remote
+    class Bad:
+        def fail(self):
+            raise RuntimeError("actor method failed")
+
+    b = Bad.remote()
+    with pytest.raises(TaskError, match="actor method failed"):
+        ray_tpu.get(b.fail.remote(), timeout=30)
+
+
+def test_actor_init_exception(ray_start_2cpu):
+    @ray_tpu.remote
+    class BadInit:
+        def __init__(self):
+            raise RuntimeError("init failed")
+
+        def ping(self):
+            return 1
+
+    b = BadInit.remote()
+    with pytest.raises((TaskError, ActorDiedError)):
+        ray_tpu.get(b.ping.remote(), timeout=30)
+
+
+def test_kill_actor(ray_start_2cpu):
+    @ray_tpu.remote
+    class Victim:
+        def ping(self):
+            return "pong"
+
+    v = Victim.remote()
+    assert ray_tpu.get(v.ping.remote(), timeout=30) == "pong"
+    ray_tpu.kill(v)
+    time.sleep(0.3)
+    with pytest.raises(ActorDiedError):
+        ray_tpu.get(v.ping.remote(), timeout=10)
+
+
+def test_pass_handle_to_task(ray_start_2cpu):
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+    @ray_tpu.remote
+    def bump(c):
+        return ray_tpu.get(c.inc.remote(), timeout=30)
+
+    c = Counter.remote()
+    assert ray_tpu.get(bump.remote(c), timeout=60) == 1
+    assert ray_tpu.get(bump.remote(c), timeout=60) == 2
+    assert ray_tpu.get(c.inc.remote(), timeout=30) == 3
+
+
+def test_actor_restart(ray_start_2cpu):
+    @ray_tpu.remote(max_restarts=1)
+    class Flaky:
+        def __init__(self):
+            self.n = 0
+
+        def pid(self):
+            import os
+
+            return os.getpid()
+
+        def die(self):
+            import os
+
+            os._exit(1)
+
+        def ping(self):
+            return "pong"
+
+    f = Flaky.remote()
+    pid1 = ray_tpu.get(f.pid.remote(), timeout=30)
+    f.die.remote()
+    time.sleep(1.0)
+    # After restart the actor should answer again from a new process.
+    pid2 = ray_tpu.get(f.pid.remote(), timeout=60)
+    assert pid2 != pid1
+
+
+def test_actor_task_transparent_retry(ray_start_2cpu, tmp_path):
+    """A call that dies mid-flight is retried on the restarted instance
+    (parity: reference max_task_retries semantics)."""
+    marker = str(tmp_path / "died_once")
+
+    @ray_tpu.remote(max_restarts=1, max_task_retries=1)
+    class DieOnce:
+        def work(self, marker):
+            import os
+
+            if not os.path.exists(marker):
+                open(marker, "w").close()
+                os._exit(1)
+            return 42
+
+    a = DieOnce.remote()
+    assert ray_tpu.get(a.work.remote(marker), timeout=60) == 42

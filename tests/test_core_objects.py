@@ -1,0 +1,85 @@
+"""Objects: put/get/wait/free (parity: reference test_object_store / test_wait)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import GetTimeoutError
+
+
+def test_put_get_roundtrip(ray_start_2cpu):
+    for v in [1, "s", None, {"a": [1, 2]}, (1, 2), {1, 2}, b"bytes", 1.5]:
+        assert ray_tpu.get(ray_tpu.put(v), timeout=30) == v
+
+
+def test_put_numpy_zero_copy(ray_start_2cpu):
+    arr = np.arange(1_000_000, dtype=np.float32)
+    ref = ray_tpu.put(arr)
+    out = ray_tpu.get(ref, timeout=30)
+    np.testing.assert_array_equal(out, arr)
+    out2 = ray_tpu.get(ref, timeout=30)
+    np.testing.assert_array_equal(out2, arr)
+
+
+def test_put_on_ref_rejected(ray_start_2cpu):
+    r = ray_tpu.put(1)
+    with pytest.raises(TypeError):
+        ray_tpu.put(r)
+
+
+def test_get_timeout(ray_start_2cpu):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(5)
+        return 1
+
+    ref = slow.remote()
+    with pytest.raises(GetTimeoutError):
+        ray_tpu.get(ref, timeout=0.2)
+
+
+def test_wait_basic(ray_start_2cpu):
+    @ray_tpu.remote
+    def quick(i):
+        return i
+
+    @ray_tpu.remote
+    def slow():
+        time.sleep(10)
+        return -1
+
+    refs = [quick.remote(0), quick.remote(1), slow.remote()]
+    ready, pending = ray_tpu.wait(refs, num_returns=2, timeout=30)
+    assert len(ready) == 2 and len(pending) == 1
+    assert set(ray_tpu.get(ready, timeout=30)) == {0, 1}
+
+
+def test_wait_timeout(ray_start_2cpu):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(10)
+
+    ready, pending = ray_tpu.wait([slow.remote()], timeout=0.3)
+    assert ready == []
+    assert len(pending) == 1
+
+
+def test_shared_object_many_readers(ray_start_2cpu):
+    arr = np.ones(300_000, dtype=np.float64)
+    ref = ray_tpu.put(arr)
+
+    @ray_tpu.remote
+    def total(a):
+        return float(a.sum())
+
+    refs = [total.remote(ref) for _ in range(4)]
+    assert ray_tpu.get(refs, timeout=60) == [300_000.0] * 4
+
+
+def test_cluster_resources_api(ray_start_2cpu):
+    total = ray_tpu.cluster_resources()
+    assert total["CPU"] == 2.0
+    assert ray_tpu.available_resources()["CPU"] <= 2.0
+    assert len(ray_tpu.nodes()) == 1

@@ -1,0 +1,120 @@
+"""Task submission/execution (parity: reference python/ray/tests/test_basic*.py)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import TaskError
+
+
+def test_simple_task(ray_start_2cpu):
+    @ray_tpu.remote
+    def f(x):
+        return x + 1
+
+    assert ray_tpu.get(f.remote(1), timeout=30) == 2
+
+
+def test_many_tasks(ray_start_2cpu):
+    @ray_tpu.remote
+    def sq(x):
+        return x * x
+
+    refs = [sq.remote(i) for i in range(20)]
+    assert ray_tpu.get(refs, timeout=60) == [i * i for i in range(20)]
+
+
+def test_kwargs_and_defaults(ray_start_2cpu):
+    @ray_tpu.remote
+    def g(a, b=10, *, c=100):
+        return a + b + c
+
+    assert ray_tpu.get(g.remote(1), timeout=30) == 111
+    assert ray_tpu.get(g.remote(1, b=2, c=3), timeout=30) == 6
+
+
+def test_multiple_returns(ray_start_2cpu):
+    @ray_tpu.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    r1, r2, r3 = three.remote()
+    assert ray_tpu.get([r1, r2, r3], timeout=30) == [1, 2, 3]
+
+
+def test_task_exception_propagates(ray_start_2cpu):
+    @ray_tpu.remote
+    def boom():
+        raise ValueError("bad input")
+
+    with pytest.raises(TaskError, match="bad input"):
+        ray_tpu.get(boom.remote(), timeout=30)
+
+
+def test_ref_as_arg_inlined(ray_start_2cpu):
+    @ray_tpu.remote
+    def plus(a, b):
+        return a + b
+
+    r1 = plus.remote(1, 2)
+    r2 = plus.remote(r1, 10)  # dependency on another task's output
+    assert ray_tpu.get(r2, timeout=30) == 13
+
+
+def test_large_arg_and_return(ray_start_2cpu):
+    @ray_tpu.remote
+    def double(a):
+        return a * 2
+
+    arr = np.arange(500_000, dtype=np.float64)
+    out = ray_tpu.get(double.remote(arr), timeout=60)
+    np.testing.assert_array_equal(out, arr * 2)
+
+
+def test_nested_tasks(ray_start_2cpu):
+    @ray_tpu.remote
+    def inner(x):
+        return x * 10
+
+    @ray_tpu.remote
+    def outer(x):
+        return ray_tpu.get(inner.remote(x), timeout=30) + 1
+
+    assert ray_tpu.get(outer.remote(4), timeout=60) == 41
+
+
+def test_ref_inside_container(ray_start_2cpu):
+    @ray_tpu.remote
+    def deref(lst):
+        # lst contains a borrowed ObjectRef; the task gets it explicitly
+        return ray_tpu.get(lst[0], timeout=30) + lst[1]
+
+    r = ray_tpu.put(5)
+    assert ray_tpu.get(deref.remote([r, 7]), timeout=60) == 12
+
+
+def test_options_override(ray_start_2cpu):
+    @ray_tpu.remote(num_cpus=2)
+    def f():
+        return "ok"
+
+    assert ray_tpu.get(f.options(num_cpus=1).remote(), timeout=30) == "ok"
+
+
+def test_direct_call_raises(ray_start_2cpu):
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    with pytest.raises(TypeError, match="remote"):
+        f()
+
+
+def test_resources_infeasible_stays_pending(ray_start_2cpu):
+    @ray_tpu.remote(num_cpus=64)
+    def f():
+        return 1
+
+    ref = f.remote()
+    ready, pending = ray_tpu.wait([ref], timeout=0.5)
+    assert ready == [] and pending == [ref]
