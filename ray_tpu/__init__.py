@@ -151,6 +151,15 @@ def wait(
     return w.wait(list(refs), num_returns=num_returns, timeout=timeout)
 
 
+def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
+    """Cancel a queued or running task (reference ray.cancel,
+    core_worker.proto:492 CancelTask). Non-force delivers KeyboardInterrupt
+    to the executing worker; force kills the worker process. The ref's
+    get() raises TaskCancelledError if cancellation landed."""
+    w = _require_worker()
+    return w.io.run(w.controller.call("cancel_task", task_id=ref.task_id(), force=force))
+
+
 def cluster_resources() -> dict[str, float]:
     return _require_worker().cluster_resources()["total"]
 
@@ -181,6 +190,7 @@ __all__ = [
     "put",
     "get",
     "wait",
+    "cancel",
     "kill",
     "get_actor",
     "ObjectRef",

@@ -55,6 +55,7 @@ class _ActorEntry:
     __slots__ = (
         "spec", "state", "node_id", "worker_id", "address", "instance",
         "restarts_used", "name", "namespace", "death_cause", "waiters",
+        "resources_held",
     )
 
     def __init__(self, spec: TaskSpec):
@@ -69,6 +70,7 @@ class _ActorEntry:
         self.namespace = spec.namespace
         self.death_cause = None
         self.waiters: list[asyncio.Future] = []
+        self.resources_held = False  # True while a node's resources back this actor
 
     def wake(self):
         for fut in self.waiters:
@@ -93,6 +95,9 @@ class Controller:
         self.pgs: dict[str, dict] = {}
         self.pg_bundles: dict[tuple, dict] = {}  # (pg_id, idx) -> {node, available, reserved}
         self.kv: dict[tuple, bytes] = {}
+        # task_id -> force flag, for cancels that land while the task is
+        # queued or mid-dispatch (neither pending nor dispatched yet).
+        self.cancelled: dict[str, bool] = {}
         self._sched_wakeup = asyncio.Event()
         self._tasks: list[asyncio.Task] = []
         self._stopping = False
@@ -174,20 +179,45 @@ class Controller:
 
     async def _schedule_once(self):
         # Single pass over the queue; tasks that can't be placed stay queued.
+        # Dispatch RPCs run concurrently (ensure_future) so one node's slow
+        # worker acquisition cannot stall cluster-wide placement (the agent
+        # may wait up to worker_register_timeout_s for a free worker).
         still_pending: deque[TaskSpec] = deque()
         while self.pending:
             spec = self.pending.popleft()
+            if spec.task_id in self.cancelled:
+                self.cancelled.pop(spec.task_id, None)
+                await self._finish_cancelled(spec)
+                continue
             demand = ResourceSet(_raw=spec.resources)
             nid = pick_node(demand, spec.strategy, self.nodes, self.pg_bundles)
             if nid is None:
                 still_pending.append(spec)
                 continue
             self._consume(nid, spec, demand)
-            ok = await self._dispatch(nid, spec)
-            if not ok:
-                self._release(nid, spec, demand)
-                still_pending.append(spec)
+            asyncio.ensure_future(self._dispatch_bg(nid, spec, demand))
         self.pending.extend(still_pending)
+
+    async def _dispatch_bg(self, nid: str, spec: TaskSpec, demand: ResourceSet):
+        ok = await self._dispatch(nid, spec)
+        if not ok:
+            self._release(nid, spec, demand)
+            self.pending.append(spec)
+            self._kick()
+            return
+        # A cancel may have landed while the dispatch RPC was in flight
+        # (worker still starting): deliver it now that we know the worker.
+        if spec.task_id in self.cancelled:
+            force = self.cancelled.pop(spec.task_id)
+            info = self.dispatched.get(spec.task_id)
+            nconn = self.node_conns.get(nid)
+            if info is not None and nconn is not None and not nconn.closed:
+                spec.max_retries = 0
+                try:
+                    await nconn.push("cancel_task", worker_id=info["worker_id"],
+                                     task_id=spec.task_id, force=force)
+                except Exception:
+                    pass
 
     def _consume(self, nid: str, spec: TaskSpec, demand: ResourceSet):
         if spec.strategy.kind == "PLACEMENT_GROUP":
@@ -216,7 +246,10 @@ class Controller:
             return False
         try:
             rep = await conn.call("dispatch", spec=spec)
-        except rpc.RpcError:
+        except Exception:
+            # Any transport failure (RpcError, reset, broken pipe): the
+            # caller releases resources and re-queues; a raw OSError must not
+            # kill the fire-and-forget _dispatch_bg task and leak capacity.
             return False
         self.dispatched[spec.task_id] = {"spec": spec, "node_id": nid, "worker_id": rep["worker_id"]}
         if spec.kind == ACTOR_CREATE:
@@ -224,6 +257,7 @@ class Controller:
             if ent is not None:
                 ent.node_id = nid
                 ent.worker_id = rep["worker_id"]
+                ent.resources_held = True
         return True
 
     async def _h_submit_task(self, conn, a):
@@ -238,6 +272,8 @@ class Controller:
     # ------------------------------------------------------ task completion
     async def _p_task_done(self, conn, a):
         task_id = a["task_id"]
+        self.cancelled.pop(task_id, None)  # completed: stale cancel marker must
+        # not kill a later lineage reconstruction of the same task_id
         info = self.dispatched.pop(task_id, None)
         spec: Optional[TaskSpec] = info["spec"] if info else a.get("spec")
         if info is not None and spec.kind != ACTOR_CREATE:
@@ -249,6 +285,14 @@ class Controller:
             return
 
         error = a.get("error")
+        # Application-level retry: the worker flags user exceptions as
+        # retryable when retry_exceptions allows (reference task_manager.cc
+        # retries on both system and, when opted-in, application errors).
+        if (error is not None and a.get("retryable") and spec is not None
+                and spec.attempt < spec.max_retries):
+            await self._retry_or_fail(spec, "user exception (retry_exceptions)",
+                                      final_error=error)
+            return
         for oid, inline, size, holder in a.get("results", []):
             ent = self.objects.setdefault(oid, _ObjectEntry())
             if error is not None:
@@ -278,6 +322,7 @@ class Controller:
     async def _p_task_failed(self, conn, a):
         """Worker/system failure (not a user exception): retry or fail."""
         task_id = a["task_id"]
+        self.cancelled.pop(task_id, None)
         info = self.dispatched.pop(task_id, None)
         if info is None:
             return
@@ -287,7 +332,7 @@ class Controller:
         await self._retry_or_fail(spec, a.get("reason", "worker died"))
         self._kick()
 
-    async def _retry_or_fail(self, spec: TaskSpec, reason: str):
+    async def _retry_or_fail(self, spec: TaskSpec, reason: str, final_error=None):
         if spec.kind == ACTOR_CREATE:
             await self._maybe_restart_actor(spec.actor_id, reason)
             return
@@ -298,15 +343,56 @@ class Controller:
             self.pending.append(spec)
             self._kick()
             return
-        from ray_tpu._private.serialization import dumps_oob
+        if final_error is None:
+            from ray_tpu._private.serialization import dumps_oob
 
-        err_header, err_bufs = dumps_oob({"type": "WorkerCrashedError", "message": reason})
+            err_header, err_bufs = dumps_oob({"type": "WorkerCrashedError", "message": reason})
+            final_error = [err_header, *err_bufs]
         for oid in spec.return_object_ids():
             ent = self.objects.setdefault(oid, _ObjectEntry())
             ent.state = "ready"
-            ent.error = [err_header, *err_bufs]
+            ent.error = final_error
             ent.wake()
             await self._notify_owner(ent, oid)
+
+    async def _finish_cancelled(self, spec: TaskSpec):
+        from ray_tpu._private.serialization import dumps_oob
+
+        h, b = dumps_oob({"type": "TaskCancelledError", "message": f"task {spec.name} cancelled"})
+        for oid in spec.return_object_ids():
+            ent = self.objects.setdefault(oid, _ObjectEntry())
+            ent.state = "ready"
+            ent.error = [h, *b]
+            ent.wake()
+            await self._notify_owner(ent, oid)
+
+    async def _h_cancel_task(self, conn, a):
+        """Cancel a queued or running task (reference core_worker.proto:492
+        CancelTask; force_kill semantics from python/ray/_private/worker.py
+        cancel). Queued: removed before dispatch. Running: the node agent
+        interrupts (KeyboardInterrupt) or kills (force) the worker."""
+        task_id = a["task_id"]
+        force = a.get("force", False)
+        for spec in list(self.pending):
+            if spec.task_id == task_id:
+                self.pending.remove(spec)
+                await self._finish_cancelled(spec)
+                return {"status": "cancelled_pending"}
+        info = self.dispatched.get(task_id)
+        if info is not None:
+            info["spec"].max_retries = 0  # a cancelled task must not retry
+            nconn = self.node_conns.get(info["node_id"])
+            if nconn is not None and not nconn.closed:
+                try:
+                    await nconn.push("cancel_task", worker_id=info["worker_id"],
+                                     task_id=task_id, force=force)
+                except Exception:
+                    pass
+            return {"status": "cancelling_running"}
+        # Not queued and not dispatched: either mid-dispatch or not yet
+        # submitted — park the marker; the schedule/dispatch paths consume it.
+        self.cancelled[task_id] = force
+        return {"status": "marked"}
 
     # ------------------------------------------------------------- objects
     async def _h_register_put(self, conn, a):
@@ -362,7 +448,9 @@ class Controller:
         out = []
         for oid in a["oids"]:
             ent = self.objects.get(oid)
-            out.append(ent is not None and ent.state == "ready")
+            # "lost" counts as ready-to-return: wait() surfaces it so the
+            # subsequent get() can raise / trigger lineage reconstruction.
+            out.append(ent is not None and ent.state in ("ready", "lost"))
         return {"ready": out}
 
     async def _p_free_objects(self, conn, a):
@@ -410,8 +498,13 @@ class Controller:
         logger.info("actor %s alive at %s", spec.name, ent.address)
 
     def _release_actor_resources(self, ent: _ActorEntry):
+        if not ent.resources_held:
+            return  # already released for this instance (idempotent)
+        ent.resources_held = False
         if ent.node_id is not None:
-            self._release(ent.node_id, ent.spec, ResourceSet(_raw=ent.spec.resources))
+            node = self.nodes.get(ent.node_id)
+            if node is not None and node.alive:
+                self._release(ent.node_id, ent.spec, ResourceSet(_raw=ent.spec.resources))
             self._kick()
 
     async def _h_get_actor_info(self, conn, a):
@@ -448,12 +541,13 @@ class Controller:
             return {}
         if a.get("no_restart", True):
             ent.spec.max_restarts = 0
-        if ent.worker_id is not None and ent.node_id in self.node_conns:
+        wid = ent.worker_id
+        if wid is not None and ent.node_id in self.node_conns:
             try:
-                await self.node_conns[ent.node_id].push("kill_worker", worker_id=ent.worker_id)
+                await self.node_conns[ent.node_id].push("kill_worker", worker_id=wid)
             except Exception:
                 pass
-        await self._actor_worker_died(a["actor_id"], "killed via kill()")
+        await self._actor_worker_died(a["actor_id"], "killed via kill()", worker_id=wid)
         return {}
 
     async def _maybe_restart_actor(self, actor_id: str, reason: str):
@@ -481,13 +575,26 @@ class Controller:
             if ent.name:
                 self.named_actors.pop((ent.namespace, ent.name), None)
 
-    async def _actor_worker_died(self, actor_id: str, reason: str):
+    async def _actor_worker_died(self, actor_id: str, reason: str, worker_id: str | None = None):
+        """Process the death of one actor *instance*. Idempotent: each
+        instance's death is consumed exactly once (keyed by the instance's
+        worker_id), so a kill() followed by the agent's worker_died report
+        cannot double-release resources or double-restart (round-1 advisor
+        finding; reference keys restarts by actor instance in
+        gcs_actor_manager.cc)."""
         ent = self.actors.get(actor_id)
         if ent is None or ent.state == "DEAD":
             return
+        if worker_id is not None:
+            if ent.worker_id != worker_id:
+                return  # stale report for an already-handled instance
+        elif ent.state == "RESTARTING":
+            return  # death already being handled; a restart is in flight
         # Drop any in-flight creation bookkeeping.
         self.dispatched.pop(ent.spec.task_id, None)
         self._release_actor_resources(ent)
+        ent.worker_id = None  # instance death consumed
+        ent.address = None
         await self._maybe_restart_actor(actor_id, reason)
 
     async def _p_worker_died(self, conn, a):
@@ -495,7 +602,9 @@ class Controller:
         actor_id = a.get("actor_id")
         task_id = a.get("task_id")
         if actor_id:
-            await self._actor_worker_died(actor_id, f"worker process died: {a.get('reason', '')}")
+            await self._actor_worker_died(
+                actor_id, f"worker process died: {a.get('reason', '')}",
+                worker_id=a.get("worker_id"))
         if task_id:
             info = self.dispatched.pop(task_id, None)
             if info is not None:
@@ -521,14 +630,26 @@ class Controller:
         # Restart/kill its actors.
         for actor_id, ent in list(self.actors.items()):
             if ent.node_id == nid and ent.state in ("ALIVE", "PENDING", "RESTARTING"):
+                ent.resources_held = False  # node gone; nothing to give back
+                ent.worker_id = None
+                ent.address = None
                 await self._maybe_restart_actor(actor_id, f"node {nid[:8]} died")
         # Mark objects whose only copies were there as lost -> owners may
         # reconstruct from lineage (reference object_recovery_manager.cc:26).
-        dead_addr_host_port = node.address
-        for oid, ent in self.objects.items():
+        dead_addr = node.address
+        for oid, ent in list(self.objects.items()):  # handlers may insert during awaits
             if ent.state != "ready" or ent.inline is not None:
                 continue
-            ent.holders = {h for h in ent.holders if h[:2] != dead_addr_host_port[:2] or h[1] != dead_addr_host_port[1]}
+            ent.holders = {h for h in ent.holders if tuple(h) != tuple(dead_addr)}
+            if not ent.holders and ent.error is None:
+                ent.state = "lost"
+                ent.wake()
+                owner_conn = self.client_conns.get(ent.owner)
+                if owner_conn is not None and not owner_conn.closed:
+                    try:
+                        await owner_conn.push("object_lost", oid=oid)
+                    except Exception:
+                        pass
         # PG bundles on the node are lost.
         for (pgid, idx), b in list(self.pg_bundles.items()):
             if b["node"] == nid:

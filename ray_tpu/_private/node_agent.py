@@ -29,7 +29,7 @@ logger = logging.getLogger(__name__)
 
 class _WorkerSlot:
     __slots__ = ("worker_id", "proc", "conn", "state", "task_id", "actor_id", "address",
-                 "registered", "dedicated")
+                 "registered", "dedicated", "idle_since")
 
     def __init__(self, worker_id: str, proc, dedicated: bool = False):
         self.worker_id = worker_id
@@ -41,6 +41,7 @@ class _WorkerSlot:
         self.address = None
         self.registered = asyncio.Event()
         self.dedicated = dedicated  # spawned for an actor; never joins the pool
+        self.idle_since: float = 0.0
 
 
 class NodeAgent:
@@ -123,6 +124,17 @@ class NodeAgent:
             slot = self.workers.get(a["worker_id"])
             if slot is not None:
                 self._kill_slot(slot)
+        elif method == "cancel_task":
+            slot = self.workers.get(a["worker_id"])
+            if slot is None or slot.task_id != a["task_id"]:
+                return
+            if a.get("force"):
+                self._kill_slot(slot)
+            elif slot.conn is not None and not slot.conn.closed:
+                try:
+                    await slot.conn.push("cancel", task_id=a["task_id"])
+                except Exception:
+                    pass
         elif method == "shutdown":
             await self.stop()
 
@@ -211,6 +223,9 @@ class NodeAgent:
     def _worker_became_idle(self, slot: _WorkerSlot):
         slot.state = "idle"
         slot.task_id = None
+        import time
+
+        slot.idle_since = time.monotonic()
         while self._idle_waiters:
             fut = self._idle_waiters.popleft()
             if not fut.done():
@@ -233,7 +248,11 @@ class NodeAgent:
             RT_CONTROLLER=f"{self.controller_addr[0]}:{self.controller_addr[1]}",
             RT_AGENT=f"{self.host}:{self.port}",
         )
-        if runtime_env:
+        # Only dedicated (actor) workers bake the runtime env into the
+        # process; pool workers apply+restore env per task instead, so a
+        # reused worker can't leak a previous task's env (reference keys the
+        # pool by runtime env, worker_pool.h:228).
+        if runtime_env and dedicated:
             for k, v in (runtime_env.get("env_vars") or {}).items():
                 env[k] = str(v)
         proc = subprocess.Popen(
@@ -255,12 +274,24 @@ class NodeAgent:
 
     async def _reap_loop(self):
         """Detect worker process exits (reference: raylet learns via socket
-        disconnect + waitpid; we poll)."""
+        disconnect + waitpid; we poll) and reap long-idle pool workers
+        (reference worker_pool.cc TryKillingIdleWorkers,
+        idle_worker_killing_time_threshold_ms), keeping one warm."""
+        import time
+
         while True:
             await asyncio.sleep(0.2)
             for wid, slot in list(self.workers.items()):
                 if slot.proc.poll() is not None and slot.state != "dead":
                     await self._worker_exited(slot, f"exit code {slot.proc.returncode}")
+            keep = CONFIG.idle_worker_keep_s
+            if keep > 0:
+                idle = [s for s in self.workers.values() if s.state == "idle" and not s.dedicated]
+                now = time.monotonic()
+                warm = 1 if CONFIG.prestart_workers else 0
+                for slot in sorted(idle, key=lambda s: s.idle_since)[: max(0, len(idle) - warm)]:
+                    if now - slot.idle_since > keep:
+                        self._kill_slot(slot)
 
     async def _worker_exited(self, slot: _WorkerSlot, reason: str):
         if slot.state == "dead":

@@ -45,7 +45,7 @@ class LocalStore:
     def put(self, oid: str, parts: list) -> int:
         """Write a flattened object blob (list of bytes-like) into shm.
         Returns total size. Idempotent per oid."""
-        total = sum(len(p) for p in parts)
+        total = sum(p.nbytes if isinstance(p, memoryview) else len(p) for p in parts)
         with self._lock:
             if oid in self._objects:
                 return self._objects[oid]["size"]
@@ -59,7 +59,9 @@ class LocalStore:
                 os.close(fd)
             off = 0
             for p in parts:
-                mm[off : off + len(p)] = bytes(p) if not isinstance(p, (bytes, bytearray, memoryview)) else p
+                if not isinstance(p, (bytes, bytearray)):
+                    p = memoryview(p).cast("B")  # write raw buffer, no copy
+                mm[off : off + len(p)] = p
                 off += len(p)
             self._objects[oid] = {
                 "size": total,

@@ -118,6 +118,29 @@ class Connection:
         finally:
             self._pending.pop(rid, None)
 
+    async def call_start(self, method: str, **payload) -> asyncio.Future:
+        """Write a request and return the reply future WITHOUT awaiting it.
+
+        Lets a caller serialize request *ordering* (the write happens before
+        this returns) while overlapping many in-flight replies — the mechanism
+        behind ordered-but-pipelined actor calls (reference: sequence numbers
+        in core_worker/transport/sequential_actor_submit_queue.h).
+        The caller must consume the future (and pop it from _pending on error).
+        """
+        self._next_id += 1
+        rid = self._next_id
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._pending[rid] = fut
+        try:
+            await self._write({"k": "req", "id": rid, "m": method, "a": payload})
+        except Exception:
+            self._pending.pop(rid, None)
+            raise
+        def _done(f, rid=rid):
+            self._pending.pop(rid, None)
+        fut.add_done_callback(_done)
+        return fut
+
     async def push(self, method: str, **payload):
         await self._write({"k": "push", "m": method, "a": payload})
 

@@ -52,6 +52,27 @@ class SerializedObject:
             parts.append(bytes(b) if not isinstance(b, (bytes, bytearray)) else b)
         return b"".join(parts)
 
+    def to_parts(self) -> list:
+        """Same byte stream as to_bytes() but as a list of parts, so the shm
+        store can write each raw buffer straight into the mmap — one copy
+        total on the put path (reference plasma writes once into shm;
+        round-1 joined everything first = two extra full copies)."""
+        import struct
+
+        ref_oids = [r.hex() if hasattr(r, "hex") else r for r in self.contained_refs]
+        meta = [struct.pack("<I", len(ref_oids))]
+        for h in ref_oids:
+            hb = h.encode()
+            meta.append(struct.pack("<H", len(hb)))
+            meta.append(hb)
+        meta.append(struct.pack("<I", len(self.buffers)))
+        meta.append(struct.pack("<Q", len(self.header)))
+        parts = [b"".join(meta), self.header]
+        for b in self.buffers:
+            parts.append(struct.pack("<Q", len(b)))
+            parts.append(b)
+        return parts
+
     @staticmethod
     def from_buffer(buf) -> "SerializedObject":
         """Zero-copy parse from a contiguous blob (memoryview over shm).

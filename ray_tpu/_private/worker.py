@@ -124,6 +124,14 @@ class _Resolution:
         self.error = error
         self.event.set()
 
+    def reset(self):
+        """Re-arm in place (reconstruction): getters already blocked on
+        `event` keep waiting on THIS object, so it must not be replaced."""
+        self.inline = None
+        self.holders = []
+        self.error = None
+        self.event.clear()
+
 
 _global_worker: Optional["Worker"] = None
 _global_lock = threading.Lock()
@@ -166,6 +174,11 @@ class Worker:
         self._actor_conns: dict[str, rpc.Connection] = {}
         self._actor_info: dict[str, dict] = {}
         self._actor_seq: dict[str, int] = {}
+        # Per-actor asyncio locks serializing connect+write so calls arrive
+        # in submission order while replies overlap (reference
+        # sequential_actor_submit_queue.h — per-caller ordering guarantee).
+        self._actor_send_locks: dict[str, asyncio.Lock] = {}
+        self._submit_lock = threading.Lock()
         # Hook used by worker_proc to execute actor calls in-order:
         self.actor_call_handler = None  # async def (spec) -> reply dict
         self._shutdown = False
@@ -237,6 +250,15 @@ class Worker:
         if method == "object_ready":
             res = self._resolutions.setdefault(a["oid"], _Resolution())
             res.resolve(a.get("inline"), [tuple(h) for h in a.get("holders", [])], a.get("error"))
+        elif method == "object_lost":
+            # All copies died with a node. Reconstruct from lineage if we can
+            # (reference object_recovery_manager.cc:26), else fail waiters.
+            oid = a["oid"]
+            if not self._maybe_reconstruct_async(oid):
+                h, bufs = dumps_oob({"type": "ObjectLostError",
+                                     "message": f"object {oid[:16]} lost (node died)"})
+                res = self._resolutions.setdefault(oid, _Resolution())
+                res.resolve(None, [], [h, *bufs])
 
     # ----------------------------------------------------------- refcounts
     def _incref(self, oid: str):
@@ -287,7 +309,7 @@ class Worker:
                     "register_put", oid=oid, size=size, inline=parts,
                     holder=self.server_addr, owner=self.worker_id))
         else:
-            self.store.put(oid, [sobj.to_bytes()])
+            self.store.put(oid, sobj.to_parts())
             holder = self.agent_addr or self.server_addr
             if register:
                 self.io.run(self.controller.call(
@@ -395,9 +417,29 @@ class Worker:
         if spec is None:
             return False
         logger.warning("reconstructing %s via task %s", oid[:12], spec.name)
-        self._resolutions[oid] = _Resolution()
+        self._reset_resolution(oid)
         spec.attempt += 1
         self.io.run(self.controller.call("submit_task", spec=spec))
+        return True
+
+    def _reset_resolution(self, oid: str):
+        res = self._resolutions.get(oid)
+        if res is None:
+            self._resolutions[oid] = _Resolution()
+        else:
+            res.reset()
+
+    def _maybe_reconstruct_async(self, oid: str) -> bool:
+        """Same as _maybe_reconstruct but safe to call ON the IO loop."""
+        if not CONFIG.lineage_reconstruction_enabled:
+            return False
+        spec = self._lineage.get(oid)
+        if spec is None:
+            return False
+        logger.warning("reconstructing %s via task %s (async)", oid[:12], spec.name)
+        self._reset_resolution(oid)
+        spec.attempt += 1
+        asyncio.ensure_future(self.controller.call("submit_task", spec=spec))
         return True
 
     def _deserialize_blob(self, mv):
@@ -431,7 +473,9 @@ class Worker:
         if etype == "ActorDiedError":
             return exc.ActorDiedError(blob.get("message", ""))
         if etype == "TaskCancelledError":
-            return exc.RayTpuError(f"task cancelled: {blob.get('message', '')}")
+            return exc.TaskCancelledError(blob.get("message", "task cancelled"))
+        if etype == "ObjectLostError":
+            return exc.ObjectLostError(blob.get("message", "object lost"))
         return exc.RayTpuError(str(blob))
 
     # ---------------------------------------------------------------- wait
@@ -637,44 +681,64 @@ class Worker:
         for oid in spec.return_object_ids():
             self._resolutions[oid] = _Resolution()
             refs.append(ObjectRef(oid, owned=True, worker=self))
-        self.io.spawn(self._a_send_actor_call(actor_id, spec, max(0, max_task_retries)))
+        with self._submit_lock:
+            seq = self._actor_seq.get(actor_id, 0)
+            self._actor_seq[actor_id] = seq + 1
+            spec.attempt = 0
+            spec.seq = seq
+            self.io.spawn(self._a_send_actor_call(actor_id, spec, max(0, max_task_retries)))
         return refs
 
     async def _a_send_actor_call(self, actor_id: str, spec: TaskSpec, retries_left: int):
         """Direct actor call with transparent retry across actor restarts
         (reference ActorTaskSubmitter: queued calls resubmitted on restart
-        when max_task_retries allows)."""
+        when max_task_retries allows).
+
+        Ordering: the per-actor send lock is held from connection resolution
+        until the request bytes are written, so requests from this caller
+        arrive at the actor in submission order; replies are awaited outside
+        the lock so many calls stay in flight (pipelined)."""
+        lock = self._actor_send_locks.setdefault(actor_id, asyncio.Lock())
         connect_attempts = 0
         while True:
-            try:
-                conn = await self._a_actor_conn(actor_id)
-            except (exc.ActorError, exc.TaskError) as e:
-                self._fail_actor_call(spec, e)
-                return
-            except Exception as e:
-                # Stale address or refused connection: re-resolve a few times
-                # (the actor may be mid-restart and not yet re-registered).
-                self._actor_conns.pop(actor_id, None)
-                self._actor_info.pop(actor_id, None)
-                connect_attempts += 1
-                if connect_attempts <= 20:
-                    await asyncio.sleep(0.1)
-                    continue
-                self._fail_actor_call(spec, e)
-                return
-            try:
-                rep = await conn.call("actor_call", spec=spec)
-            except Exception:
-                self._actor_conns.pop(actor_id, None)
-                self._actor_info.pop(actor_id, None)
-                if retries_left > 0:
-                    retries_left -= 1
-                    await asyncio.sleep(CONFIG.task_retry_delay_s)
-                    continue
-                self._fail_actor_call(
-                    spec, exc.ActorDiedError(f"actor {actor_id[:12]} died mid-call"))
-                return
-            self._apply_actor_reply(spec, rep)
+            async with lock:
+                try:
+                    conn = await self._a_actor_conn(actor_id)
+                except (exc.ActorError, exc.TaskError) as e:
+                    self._fail_actor_call(spec, e)
+                    return
+                except Exception as e:
+                    # Stale address or refused connection: re-resolve a few
+                    # times (the actor may be mid-restart, not re-registered).
+                    self._actor_conns.pop(actor_id, None)
+                    self._actor_info.pop(actor_id, None)
+                    connect_attempts += 1
+                    if connect_attempts <= 20:
+                        await asyncio.sleep(0.1)
+                        continue
+                    self._fail_actor_call(spec, e)
+                    return
+                try:
+                    fut = await conn.call_start("actor_call", spec=spec)
+                except Exception:
+                    self._actor_conns.pop(actor_id, None)
+                    fut = None
+            if fut is not None:
+                try:
+                    rep = await fut
+                    self._apply_actor_reply(spec, rep)
+                    return
+                except Exception:
+                    pass
+            # The connection died mid-call: retry across restart if allowed.
+            self._actor_conns.pop(actor_id, None)
+            self._actor_info.pop(actor_id, None)
+            if retries_left > 0:
+                retries_left -= 1
+                await asyncio.sleep(CONFIG.task_retry_delay_s)
+                continue
+            self._fail_actor_call(
+                spec, exc.ActorDiedError(f"actor {actor_id[:12]} died mid-call"))
             return
 
     def _fail_actor_call(self, spec: TaskSpec, e: Exception):
@@ -684,6 +748,12 @@ class Worker:
             res.resolve(None, [], [h, *bufs])
 
     def _apply_actor_reply(self, spec: TaskSpec, rep: dict):
+        if rep.get("exec_failure") and not rep.get("results"):
+            # The actor's executor layer failed before results were packaged:
+            # fail the refs rather than leaving the caller blocked forever.
+            self._fail_actor_call(spec, exc.ActorUnavailableError(
+                f"actor executor failure: {rep['exec_failure']}"))
+            return
         error = rep.get("error")
         for oid, inline, size, holder in rep.get("results", []):
             res = self._resolutions.setdefault(oid, _Resolution())

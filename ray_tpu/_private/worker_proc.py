@@ -13,6 +13,8 @@ execution thread (reference sequential_actor_submit_queue.h).
 from __future__ import annotations
 
 import asyncio
+import ctypes
+import inspect
 import logging
 import os
 import queue
@@ -49,6 +51,13 @@ class WorkerProc:
         self.agent_conn: rpc.Connection | None = None
         self.actor_instance = None
         self.actor_id: str | None = None
+        self.actor_max_concurrency = 1
+        self._actor_pool = None  # ThreadPoolExecutor for threaded actors
+        self._actor_loop = None  # EventLoopThread for async actors
+        self._actor_sem: asyncio.Semaphore | None = None
+        self._exec_thread_ident: int | None = None
+        self._current_task_id: str | None = None
+        self._cancel_requested: set[str] = set()  # cancels that beat the task
         self._running = True
 
     # ------------------------------------------------------------ startup
@@ -72,9 +81,31 @@ class WorkerProc:
     async def _on_agent_push(self, conn, method, a):
         if method == "execute":
             self.exec_queue.put(("task", a["spec"], None))
+        elif method == "cancel":
+            self._cancel_current(a["task_id"])
         elif method == "exit":
             self._running = False
             self.exec_queue.put(("exit", None, None))
+
+    def _cancel_current(self, task_id: str):
+        """Non-force cancel: raise KeyboardInterrupt in the executing thread
+        (reference: ray.cancel() delivers KeyboardInterrupt to the worker's
+        main thread, _raylet.pyx execute_task_with_cancellation_handler).
+        The exec thread is this process's main thread, so a SIGINT interrupts
+        even blocking syscalls (e.g. time.sleep); PyThreadState_SetAsyncExc
+        would only fire at the next bytecode boundary."""
+        if self._current_task_id != task_id or self._exec_thread_ident is None:
+            # The execute push may still be queued ahead of us: remember the
+            # cancel so the exec loop aborts the task before running it.
+            self._cancel_requested.add(task_id)
+            return
+        if self._exec_thread_ident == threading.main_thread().ident:
+            import signal
+
+            os.kill(os.getpid(), signal.SIGINT)
+        else:
+            ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                ctypes.c_ulong(self._exec_thread_ident), ctypes.py_object(KeyboardInterrupt))
 
     async def _handle_actor_call(self, spec: TaskSpec):
         """Called on the IO thread for direct actor calls; bridges to the
@@ -86,22 +117,78 @@ class WorkerProc:
 
     # ---------------------------------------------------------- exec loop
     def run(self):
+        self._exec_thread_ident = threading.get_ident()
         while self._running:
-            kind, spec, reply_slot = self.exec_queue.get()
+            try:
+                kind, spec, reply_slot = self.exec_queue.get()
+            except KeyboardInterrupt:
+                continue  # late cancel signal; its task already finished
             if kind == "exit":
                 break
             try:
                 if spec.kind == ACTOR_TASK:
-                    reply = self._execute_actor_task(spec)
-                    loop, fut = reply_slot
-                    loop.call_soon_threadsafe(
-                        lambda f=fut, r=reply: f.set_result(r) if not f.done() else None
-                    )
+                    self._dispatch_actor_task(spec, reply_slot)
                 else:
                     self._execute_task(spec)
             except BaseException:
                 traceback.print_exc()
         self.worker.disconnect()
+
+    def _dispatch_actor_task(self, spec: TaskSpec, reply_slot):
+        """Route an actor call to the right executor: async actors run
+        coroutine methods on a dedicated asyncio loop bounded by a
+        max_concurrency semaphore; threaded actors (max_concurrency>1) use a
+        thread pool; default actors execute inline in arrival order
+        (reference concurrency_group_manager.h + fiber.h for async actors)."""
+        method = getattr(self.actor_instance, spec.method_name, None) if self.actor_instance else None
+        if method is not None and inspect.iscoroutinefunction(method):
+            self._ensure_actor_loop()
+            cf = asyncio.run_coroutine_threadsafe(self._a_exec_actor_task(spec), self._actor_loop.loop)
+            cf.add_done_callback(lambda f, rs=reply_slot: self._reply_future(rs, f))
+        elif self.actor_max_concurrency > 1:
+            if self._actor_pool is None:
+                from concurrent.futures import ThreadPoolExecutor
+
+                self._actor_pool = ThreadPoolExecutor(max_workers=self.actor_max_concurrency,
+                                                      thread_name_prefix="rt-actor")
+            cf = self._actor_pool.submit(self._execute_actor_task, spec)
+            cf.add_done_callback(lambda f, rs=reply_slot: self._reply_future(rs, f))
+        else:
+            reply = self._execute_actor_task(spec)
+            self._reply_value(reply_slot, reply)
+
+    def _ensure_actor_loop(self):
+        if self._actor_loop is None:
+            self._actor_loop = rpc.EventLoopThread(name="rt-actor-loop")
+
+            async def _mk_sem():
+                return asyncio.Semaphore(max(1, self.actor_max_concurrency))
+
+            self._actor_sem = self._actor_loop.run(_mk_sem())
+
+    async def _a_exec_actor_task(self, spec: TaskSpec) -> dict:
+        async with self._actor_sem:
+            error_blob = None
+            value = None
+            try:
+                method = getattr(self.actor_instance, spec.method_name)
+                args, kwargs = self.worker.decode_args(spec.args, spec.kwargs)
+                value = await method(*args, **kwargs)
+            except BaseException as e:  # noqa: BLE001
+                error_blob = self._make_error_blob(spec, e)
+            return self._finish_actor_task(spec, value, error_blob)
+
+    def _reply_value(self, reply_slot, reply: dict):
+        loop, fut = reply_slot
+        loop.call_soon_threadsafe(
+            lambda f=fut, r=reply: f.set_result(r) if not f.done() else None)
+
+    def _reply_future(self, reply_slot, done_future):
+        try:
+            reply = done_future.result()
+        except BaseException as e:  # executor infrastructure failure
+            reply = {"results": [], "error": None, "exec_failure": str(e)}
+        self._reply_value(reply_slot, reply)
 
     # ---------------------------------------------------------- execution
     def _package_results(self, spec: TaskSpec, value, error_blob):
@@ -124,15 +211,18 @@ class WorkerProc:
         for oid, v in zip(oids, values):
             sobj = serialize(v, ref_class=ObjectRef)
             size = sobj.total_bytes()
-            blob = sobj.to_bytes()
             if size <= CONFIG.max_inline_object_bytes:
-                results.append((oid, [blob], size, None))
+                results.append((oid, [sobj.to_bytes()], size, None))
             else:
-                self.worker.store.put(oid, [blob])
+                self.worker.store.put(oid, sobj.to_parts())
                 results.append((oid, None, size, self.agent_addr))
         return results
 
     def _make_error_blob(self, spec: TaskSpec, e: BaseException):
+        if isinstance(e, KeyboardInterrupt):
+            h, bufs = dumps_oob({"type": "TaskCancelledError",
+                                 "message": f"task {spec.name} cancelled"})
+            return [h, *bufs]
         tb = traceback.format_exc()
         cause_header = None
         try:
@@ -151,40 +241,84 @@ class WorkerProc:
         )
         return [h, *bufs]
 
+    @staticmethod
+    def _exception_retryable(spec: TaskSpec, e: BaseException) -> bool:
+        """retry_exceptions semantics (reference remote_function.py options):
+        True -> any Exception retries; a list/tuple of types -> isinstance
+        match; False/None -> user exceptions are final."""
+        if isinstance(e, KeyboardInterrupt):
+            return False  # cancellation is never retried
+        rx = spec.retry_exceptions
+        if rx is True:
+            return isinstance(e, Exception)
+        if isinstance(rx, (list, tuple)):
+            return any(isinstance(e, t) for t in rx if isinstance(t, type))
+        return False
+
     def _execute_task(self, spec: TaskSpec):
         error_blob = None
         value = None
-        if spec.runtime_env.get("env_vars"):
-            os.environ.update({k: str(v) for k, v in spec.runtime_env["env_vars"].items()})
+        retryable = False
+        # Apply per-task env vars; restore after on pooled (non-actor)
+        # workers so a reused worker doesn't leak the previous task's env
+        # (reference keys the worker pool by runtime env, worker_pool.h:228).
+        saved_env: dict[str, str | None] = {}
+        env_vars = spec.runtime_env.get("env_vars") or {}
+        for k, v in env_vars.items():
+            saved_env[k] = os.environ.get(k)
+            os.environ[k] = str(v)
+        self._current_task_id = spec.task_id
         try:
+            if spec.task_id in self._cancel_requested:
+                self._cancel_requested.discard(spec.task_id)
+                raise KeyboardInterrupt  # cancelled before it started
             if spec.kind == ACTOR_CREATE:
                 cls = self.worker.load_function(spec.function_id)
                 args, kwargs = self.worker.decode_args(spec.args, spec.kwargs)
                 self.actor_instance = cls(*args, **kwargs)
                 self.actor_id = spec.actor_id
+                self.actor_max_concurrency = max(1, spec.max_concurrency)
             else:
                 fn = self.worker.load_function(spec.function_id)
                 args, kwargs = self.worker.decode_args(spec.args, spec.kwargs)
                 value = fn(*args, **kwargs)
         except BaseException as e:  # noqa: BLE001 — user code may raise anything
             error_blob = self._make_error_blob(spec, e)
+            retryable = self._exception_retryable(spec, e)
             if spec.kind == ACTOR_CREATE:
                 logger.error("actor __init__ failed:\n%s", traceback.format_exc())
+        finally:
+            self._current_task_id = None
+            if spec.kind != ACTOR_CREATE:  # dedicated actor procs keep their env
+                for k, old in saved_env.items():
+                    if old is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = old
         try:
+            results = self._package_results(spec, value, error_blob)
+        except KeyboardInterrupt:
+            # Late cancel signal after user code finished: the result stands.
             results = self._package_results(spec, value, error_blob)
         except BaseException as e:
             error_blob = self._make_error_blob(spec, e)
             results = self._package_results(spec, None, error_blob)
 
         async def _report():
-            payload = dict(task_id=spec.task_id, results=results, error=error_blob, spec=None)
+            payload = dict(task_id=spec.task_id, results=results, error=error_blob,
+                           retryable=retryable, spec=None)
             if spec.kind == ACTOR_CREATE:
                 payload["actor_address"] = self.worker.server_addr
             await self.worker.controller.push("task_done", **payload)
             if spec.kind == NORMAL:
                 await self.agent_conn.push("worker_idle", worker_id=self.worker_id)
 
-        self.worker.io.run(_report())
+        for _ in range(2):  # a late cancel SIGINT must not lose the report
+            try:
+                self.worker.io.run(_report())
+                break
+            except KeyboardInterrupt:
+                continue
 
     def _execute_actor_task(self, spec: TaskSpec) -> dict:
         error_blob = None
@@ -197,6 +331,9 @@ class WorkerProc:
             value = method(*args, **kwargs)
         except BaseException as e:  # noqa: BLE001
             error_blob = self._make_error_blob(spec, e)
+        return self._finish_actor_task(spec, value, error_blob)
+
+    def _finish_actor_task(self, spec: TaskSpec, value, error_blob) -> dict:
         try:
             results = self._package_results(spec, value, error_blob)
         except BaseException as e:

@@ -89,6 +89,11 @@ class ActorClass:
         if w is None:
             raise RuntimeError("ray_tpu.init() must be called before .remote()")
         o = self._options
+        lifetime = o.get("lifetime")
+        if lifetime not in (None, "detached", "non_detached"):
+            raise ValueError(f"lifetime must be None, 'detached' or 'non_detached', got {lifetime!r}")
+        # Note: all actors currently survive their creator (controller-owned
+        # state), so 'detached' is the de-facto behavior; accepted for parity.
         num_tpus = o.get("num_tpus", o.get("num_gpus"))
         resources = normalize_resources(
             num_cpus=o.get("num_cpus"),

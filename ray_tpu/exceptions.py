@@ -59,6 +59,11 @@ class OwnerDiedError(ObjectLostError):
     """The owner process of this object died, so the object is unrecoverable."""
 
 
+class TaskCancelledError(RayTpuError):
+    """The task was cancelled via ray_tpu.cancel() (reference TaskCancelledError;
+    cancel RPC core_worker.proto:492)."""
+
+
 class GetTimeoutError(RayTpuError, TimeoutError):
     """`get()` timed out."""
 
