@@ -1,0 +1,23 @@
+"""Early pytest plugin (loaded via -p in pytest.ini, BEFORE fd capture).
+
+The axon TPU tunnel pins jax's backend at interpreter start (its
+sitecustomize registers a PJRT plugin when PALLAS_AXON_POOL_IPS is set), so
+tests that need the virtual 8-device CPU mesh can't switch platforms
+in-process. Re-exec the test run once with a clean environment. This must
+happen before pytest's capture plugin redirects fd 1/2, or the re-exec'd
+process writes its report into the (discarded) capture tempfiles.
+"""
+
+import os
+import sys
+
+if os.environ.get("PALLAS_AXON_POOL_IPS") and os.environ.get("RT_TEST_REEXEC") != "1":
+    _env = dict(os.environ)
+    _env.update(
+        RT_TEST_REEXEC="1",
+        JAX_PLATFORMS="cpu",
+        PALLAS_AXON_POOL_IPS="",
+        XLA_FLAGS="--xla_force_host_platform_device_count=8 "
+        + os.environ.get("XLA_FLAGS", ""),
+    )
+    os.execvpe(sys.executable, [sys.executable, "-m", "pytest"] + sys.argv[1:], _env)

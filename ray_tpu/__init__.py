@@ -151,11 +151,12 @@ def wait(
     return w.wait(list(refs), num_returns=num_returns, timeout=timeout)
 
 
-def cancel(ref: ObjectRef, *, force: bool = False, recursive: bool = True):
+def cancel(ref: ObjectRef, *, force: bool = False):
     """Cancel a queued or running task (reference ray.cancel,
     core_worker.proto:492 CancelTask). Non-force delivers KeyboardInterrupt
-    to the executing worker; force kills the worker process. The ref's
-    get() raises TaskCancelledError if cancellation landed."""
+    to the executing worker and get() raises TaskCancelledError; force kills
+    the worker process and get() raises WorkerCrashedError. Child tasks are
+    not cancelled recursively."""
     w = _require_worker()
     return w.io.run(w.controller.call("cancel_task", task_id=ref.task_id(), force=force))
 

@@ -95,9 +95,12 @@ class Controller:
         self.pgs: dict[str, dict] = {}
         self.pg_bundles: dict[tuple, dict] = {}  # (pg_id, idx) -> {node, available, reserved}
         self.kv: dict[tuple, bytes] = {}
-        # task_id -> force flag, for cancels that land while the task is
+        # task_id -> (force, expiry), for cancels that land while the task is
         # queued or mid-dispatch (neither pending nor dispatched yet).
-        self.cancelled: dict[str, bool] = {}
+        # Entries expire so cancels racing completion (or actor-method refs
+        # that never pass through scheduling) can't leak or poison a later
+        # lineage reconstruction of the same task_id.
+        self.cancelled: dict[str, tuple[bool, float]] = {}
         self._sched_wakeup = asyncio.Event()
         self._tasks: list[asyncio.Task] = []
         self._stopping = False
@@ -185,8 +188,7 @@ class Controller:
         still_pending: deque[TaskSpec] = deque()
         while self.pending:
             spec = self.pending.popleft()
-            if spec.task_id in self.cancelled:
-                self.cancelled.pop(spec.task_id, None)
+            if self._consume_cancel(spec.task_id) is not None:
                 await self._finish_cancelled(spec)
                 continue
             demand = ResourceSet(_raw=spec.resources)
@@ -208,16 +210,18 @@ class Controller:
         # A cancel may have landed while the dispatch RPC was in flight
         # (worker still starting): deliver it now that we know the worker.
         if spec.task_id in self.cancelled:
-            force = self.cancelled.pop(spec.task_id)
+            spec.max_retries = 0  # a cancelled task must never retry
             info = self.dispatched.get(spec.task_id)
             nconn = self.node_conns.get(nid)
             if info is not None and nconn is not None and not nconn.closed:
-                spec.max_retries = 0
+                force, _ = self.cancelled.pop(spec.task_id)
                 try:
                     await nconn.push("cancel_task", worker_id=info["worker_id"],
                                      task_id=spec.task_id, force=force)
                 except Exception:
                     pass
+            # else: leave the marker parked — if the node dies the requeue
+            # path consumes it in _schedule_once/_p_task_failed.
 
     def _consume(self, nid: str, spec: TaskSpec, demand: ResourceSet):
         if spec.strategy.kind == "PLACEMENT_GROUP":
@@ -254,10 +258,19 @@ class Controller:
         self.dispatched[spec.task_id] = {"spec": spec, "node_id": nid, "worker_id": rep["worker_id"]}
         if spec.kind == ACTOR_CREATE:
             ent = self.actors.get(spec.actor_id)
-            if ent is not None:
-                ent.node_id = nid
-                ent.worker_id = rep["worker_id"]
-                ent.resources_held = True
+            if ent is None or ent.state == "DEAD":
+                # kill() raced the creation dispatch: reap the fresh worker
+                # and give the resources back instead of resurrecting.
+                self.dispatched.pop(spec.task_id, None)
+                self._release(nid, spec, ResourceSet(_raw=spec.resources))
+                try:
+                    await conn.push("kill_worker", worker_id=rep["worker_id"])
+                except Exception:
+                    pass
+                return True
+            ent.node_id = nid
+            ent.worker_id = rep["worker_id"]
+            ent.resources_held = True
         return True
 
     async def _h_submit_task(self, conn, a):
@@ -295,6 +308,11 @@ class Controller:
             return
         for oid, inline, size, holder in a.get("results", []):
             ent = self.objects.setdefault(oid, _ObjectEntry())
+            if ent.state == "ready" and ent.error is None and error is not None:
+                # Late/duplicate error report (e.g. a cancel SIGINT landing
+                # just after completion): the first good value wins.
+                await self._notify_owner(ent, oid)
+                continue
             if error is not None:
                 ent.error = error
             ent.state = "ready"
@@ -322,13 +340,16 @@ class Controller:
     async def _p_task_failed(self, conn, a):
         """Worker/system failure (not a user exception): retry or fail."""
         task_id = a["task_id"]
-        self.cancelled.pop(task_id, None)
         info = self.dispatched.pop(task_id, None)
         if info is None:
             return
         spec: TaskSpec = info["spec"]
         if spec.kind != ACTOR_CREATE:
             self._release(info["node_id"], spec, ResourceSet(_raw=spec.resources))
+        if self._consume_cancel(task_id) is not None and spec.kind != ACTOR_CREATE:
+            await self._finish_cancelled(spec)  # cancelled task must not retry
+            self._kick()
+            return
         await self._retry_or_fail(spec, a.get("reason", "worker died"))
         self._kick()
 
@@ -391,8 +412,22 @@ class Controller:
             return {"status": "cancelling_running"}
         # Not queued and not dispatched: either mid-dispatch or not yet
         # submitted — park the marker; the schedule/dispatch paths consume it.
-        self.cancelled[task_id] = force
+        now = time.monotonic()
+        for tid, (_, exp) in list(self.cancelled.items()):
+            if exp < now:
+                self.cancelled.pop(tid, None)
+        self.cancelled[task_id] = (force, now + 60.0)
         return {"status": "marked"}
+
+    def _consume_cancel(self, task_id: str):
+        """Pop a live cancel marker; returns force flag or None."""
+        ent = self.cancelled.pop(task_id, None)
+        if ent is None:
+            return None
+        force, exp = ent
+        if exp < time.monotonic():
+            return None
+        return force
 
     # ------------------------------------------------------------- objects
     async def _h_register_put(self, conn, a):
@@ -483,6 +518,17 @@ class Controller:
     async def _actor_started(self, spec: TaskSpec, a: dict, info):
         ent = self.actors.get(spec.actor_id)
         if ent is None:
+            return
+        if ent.state == "DEAD":
+            # Killed while __init__ was running: do not resurrect; reap the
+            # worker and release whatever _dispatch accounted to it.
+            if ent.worker_id is not None and ent.node_id in self.node_conns:
+                try:
+                    await self.node_conns[ent.node_id].push(
+                        "kill_worker", worker_id=ent.worker_id)
+                except Exception:
+                    pass
+            self._release_actor_resources(ent)
             return
         if a.get("error") is not None:
             # Actor __init__ raised: actor is DEAD with that cause.

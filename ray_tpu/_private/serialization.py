@@ -32,25 +32,14 @@ class SerializedObject:
         return len(self.header) + sum(len(b) for b in self.buffers)
 
     def to_bytes(self) -> bytes:
-        """Flatten to a single contiguous blob (for shm store / wire).
+        """Flatten to a single contiguous blob (for inline/wire payloads).
         Layout: [4B nrefs][nrefs * (2B len + oid hex)] [4B nbufs][8B hlen]
         [header][ (8B len, raw)* ]. Contained refs are stored by id so a
-        deserializer in another process can re-hydrate borrowed ObjectRefs."""
-        import struct
-
-        ref_oids = [r.hex() if hasattr(r, "hex") else r for r in self.contained_refs]
-        parts = [struct.pack("<I", len(ref_oids))]
-        for h in ref_oids:
-            hb = h.encode()
-            parts.append(struct.pack("<H", len(hb)))
-            parts.append(hb)
-        parts.append(struct.pack("<I", len(self.buffers)))
-        parts.append(struct.pack("<Q", len(self.header)))
-        parts.append(self.header)
-        for b in self.buffers:
-            parts.append(struct.pack("<Q", len(b)))
-            parts.append(bytes(b) if not isinstance(b, (bytes, bytearray)) else b)
-        return b"".join(parts)
+        deserializer in another process can re-hydrate borrowed ObjectRefs.
+        Single source of truth for the layout is to_parts()."""
+        return b"".join(
+            p if isinstance(p, (bytes, bytearray)) else bytes(p)
+            for p in self.to_parts())
 
     def to_parts(self) -> list:
         """Same byte stream as to_bytes() but as a list of parts, so the shm

@@ -61,9 +61,6 @@ class TaskSpec:
     get_if_exists: bool = False
     # retry bookkeeping (mutated by controller):
     attempt: int = 0
-    # Per-caller actor-call sequence number (reference
-    # sequential_actor_submit_queue.h) — diagnostic ordering witness.
-    seq: int = 0
 
     def return_object_ids(self) -> list[str]:
         from ray_tpu._private.ids import ObjectID, TaskID

@@ -173,7 +173,6 @@ class Worker:
         # Direct actor transport:
         self._actor_conns: dict[str, rpc.Connection] = {}
         self._actor_info: dict[str, dict] = {}
-        self._actor_seq: dict[str, int] = {}
         # Per-actor asyncio locks serializing connect+write so calls arrive
         # in submission order while replies overlap (reference
         # sequential_actor_submit_queue.h — per-caller ordering guarantee).
@@ -681,11 +680,9 @@ class Worker:
         for oid in spec.return_object_ids():
             self._resolutions[oid] = _Resolution()
             refs.append(ObjectRef(oid, owned=True, worker=self))
+        # call_soon_threadsafe is FIFO per thread and the per-actor send lock
+        # is FIFO, so spawning under the submit lock fixes the arrival order.
         with self._submit_lock:
-            seq = self._actor_seq.get(actor_id, 0)
-            self._actor_seq[actor_id] = seq + 1
-            spec.attempt = 0
-            spec.seq = seq
             self.io.spawn(self._a_send_actor_call(actor_id, spec, max(0, max_task_retries)))
         return refs
 

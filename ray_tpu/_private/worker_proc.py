@@ -256,6 +256,32 @@ class WorkerProc:
         return False
 
     def _execute_task(self, spec: TaskSpec):
+        """Outer shell: a cancel SIGINT can land in any crack of the inner
+        body (e.g. the env-restore finally) — whatever happens, a task_done
+        MUST reach the controller or the caller blocks and the agent counts
+        the slot busy forever."""
+        try:
+            self._execute_task_inner(spec)
+            return
+        except KeyboardInterrupt:
+            error_blob = self._make_error_blob(spec, KeyboardInterrupt())
+        results = self._package_results(spec, None, error_blob)
+
+        async def _report():
+            await self.worker.controller.push(
+                "task_done", task_id=spec.task_id, results=results,
+                error=error_blob, retryable=False, spec=None)
+            if spec.kind == NORMAL:
+                await self.agent_conn.push("worker_idle", worker_id=self.worker_id)
+
+        for _ in range(2):
+            try:
+                self.worker.io.run(_report())
+                break
+            except KeyboardInterrupt:
+                continue
+
+    def _execute_task_inner(self, spec: TaskSpec):
         error_blob = None
         value = None
         retryable = False

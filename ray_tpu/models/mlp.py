@@ -1,0 +1,26 @@
+"""Small MLP (MNIST-class) — the minimum end-to-end training model
+(SURVEY §7 stage 4 / BASELINE north-star #1: DataParallelTrainer MNIST)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    hidden: int = 128
+    n_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.hidden)(x))
+        x = nn.relu(nn.Dense(self.hidden)(x))
+        return nn.Dense(self.n_classes)(x)
+
+
+def loss_fn(model: MLP, params, batch):
+    x, y = batch
+    logits = model.apply(params, x)
+    logp = jnp.take_along_axis(nn.log_softmax(logits), y[:, None], axis=-1)
+    return -logp.mean()

@@ -1,0 +1,10 @@
+"""Compute kernels (XLA + Pallas).
+
+The hot ops live here so models call one stable surface while the
+implementation graduates from reference jax (always correct, any backend)
+to Pallas TPU kernels (ops/flash_attention.py) without touching model code.
+"""
+
+from ray_tpu.ops.attention import dot_product_attention
+
+__all__ = ["dot_product_attention"]

@@ -1,0 +1,47 @@
+"""Attention ops: reference XLA implementation with a Pallas fast path.
+
+The reference framework has no attention kernels of its own (it delegates to
+torch/vLLM); this module is the TPU-native equivalent of that delegated
+surface. `dot_product_attention` dispatches to the Pallas flash kernel on TPU
+when shapes allow (ray_tpu/ops/flash_attention.py), else to a fused-softmax
+XLA implementation that GSPMD can shard.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def dot_product_attention(q, k, v, *, causal: bool = True, use_pallas: bool | None = None):
+    """q: [B, Sq, Hq, D], k/v: [B, Sk, Hkv, D] (GQA when Hq > Hkv).
+
+    Returns [B, Sq, Hq, D]. Softmax in f32 regardless of input dtype
+    (bf16-safe), output in the input dtype.
+    """
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu"
+    if use_pallas:
+        try:
+            from ray_tpu.ops.flash_attention import flash_attention
+
+            return flash_attention(q, k, v, causal=causal)
+        except Exception:
+            pass  # shapes/backend unsupported: fall through to XLA
+    return _xla_attention(q, k, v, causal=causal)
+
+
+def _xla_attention(q, k, v, *, causal: bool):
+    b, sq, hq, d = q.shape
+    _, sk, hkv, _ = k.shape
+    if hq != hkv:  # GQA: repeat kv heads
+        rep = hq // hkv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = d ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        logits = jnp.where(mask[None, None], logits, jnp.finfo(jnp.float32).min)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)

@@ -1,0 +1,27 @@
+"""TPU-native parallelism layer: device meshes, shardings, collectives.
+
+This is the subsystem that replaces the reference's NCCL/GLOO process-group
+world (python/ray/util/collective/, python/ray/train/torch/config.py:66
+_setup_torch_process_group, python/ray/experimental/channel/nccl_group.py):
+on TPU, collective math lives *inside* compiled XLA programs as psum /
+all_gather / ppermute / all_to_all over the ICI torus, orchestrated by
+`jax.sharding.Mesh` + pjit — not as out-of-band process-group calls.
+"""
+
+from ray_tpu.parallel.mesh import (
+    MeshConfig,
+    build_mesh,
+    data_sharding,
+    local_mesh,
+    replicated,
+    shard_params,
+)
+
+__all__ = [
+    "MeshConfig",
+    "build_mesh",
+    "local_mesh",
+    "data_sharding",
+    "replicated",
+    "shard_params",
+]

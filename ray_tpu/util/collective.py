@@ -1,0 +1,249 @@
+"""Collective communication library.
+
+Parity target: reference python/ray/util/collective/collective.py
+(GroupManager:40, init_collective_group:123, allreduce:268; NCCL/GLOO
+backends under util/collective/collective_group/).
+
+TPU-native two-tier design (SURVEY §2.4/§2.5):
+- **Device tier**: collective math between chips belongs INSIDE compiled XLA
+  programs — `jax.lax.psum/all_gather/ppermute/all_to_all` over mesh axes
+  (see ray_tpu.parallel) riding ICI. There is no NCCL-style out-of-band
+  device group to manage, so this module doesn't wrap one.
+- **Host tier** (this module): cross-process collectives for host data —
+  gradient allreduce across TPU hosts (DCN), rendezvous/barriers for worker
+  groups, weight broadcast. Implemented over the cluster control plane
+  (controller KV as the rendezvous bulletin) with numpy payloads, playing
+  the role the reference's GLOO groups play.
+
+Every rank calls init_collective_group(world_size, rank, group_name) first
+(reference collective.py:123), then the collectives; calls are matched by a
+per-group monotonically increasing sequence number.
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from ray_tpu._private.worker import global_worker
+
+
+class ReduceOp:
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+
+
+_REDUCERS = {
+    ReduceOp.SUM: lambda arrs: np.sum(arrs, axis=0),
+    ReduceOp.PRODUCT: lambda arrs: np.prod(arrs, axis=0),
+    ReduceOp.MIN: lambda arrs: np.min(arrs, axis=0),
+    ReduceOp.MAX: lambda arrs: np.max(arrs, axis=0),
+}
+
+
+@dataclass
+class _Group:
+    name: str
+    world_size: int
+    rank: int
+    seq: int = 0
+
+    def __post_init__(self):
+        self.written: list[tuple[int, str]] = []  # (seq, key) for lazy GC
+
+
+class GroupManager:
+    """Per-process registry of collective groups (reference GroupManager,
+    collective.py:40)."""
+
+    def __init__(self):
+        self._groups: dict[str, _Group] = {}
+
+    def create(self, group_name: str, world_size: int, rank: int) -> _Group:
+        if not (0 <= rank < world_size):
+            raise ValueError(f"rank {rank} out of range for world_size {world_size}")
+        g = _Group(group_name, world_size, rank)
+        self._groups[group_name] = g
+        return g
+
+    def get(self, group_name: str) -> _Group:
+        if group_name not in self._groups:
+            raise ValueError(
+                f"collective group {group_name!r} not initialized in this process; "
+                f"call init_collective_group() first")
+        return self._groups[group_name]
+
+    def destroy(self, group_name: str):
+        self._groups.pop(group_name, None)
+
+
+_manager = GroupManager()
+
+
+def init_collective_group(world_size: int, rank: int, group_name: str = "default"):
+    """Join this process to a named collective group and rendezvous with the
+    other world_size-1 members (reference init_collective_group:123)."""
+    g = _manager.create(group_name, world_size, rank)
+    _kv_put(f"col/{group_name}/join/{rank}", b"1")
+    _wait_all(f"col/{group_name}/join", world_size)
+    return g
+
+
+def destroy_collective_group(group_name: str = "default"):
+    _manager.destroy(group_name)
+
+
+def get_rank(group_name: str = "default") -> int:
+    return _manager.get(group_name).rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    return _manager.get(group_name).world_size
+
+
+# ------------------------------------------------------------- collectives
+def allreduce(tensor, op: str = ReduceOp.SUM, group_name: str = "default"):
+    """Allreduce a numpy array (or pytree of arrays) across the group.
+    Returns the reduced value (functional — numpy arrays aren't views of
+    device memory here, unlike the reference's in-place NCCL semantics)."""
+    g = _manager.get(group_name)
+    seq = _next_seq(g)
+    contribs = _exchange(g, seq, tensor)
+    return _tree_reduce(contribs, op)
+
+
+def allgather(tensor, group_name: str = "default") -> list:
+    """Returns [rank0_value, rank1_value, ...]."""
+    g = _manager.get(group_name)
+    seq = _next_seq(g)
+    return _exchange(g, seq, tensor)
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
+    g = _manager.get(group_name)
+    seq = _next_seq(g)
+    key = f"col/{g.name}/{seq}/bcast"
+    if g.rank == src_rank:
+        _put_seq(g, seq, key, pickle.dumps(tensor, protocol=5))
+        _barrier_inner(g, seq)
+        return tensor
+    blob = _kv_wait(key)
+    out = pickle.loads(blob)
+    _barrier_inner(g, seq)
+    return out
+
+
+def reducescatter(tensor, op: str = ReduceOp.SUM, group_name: str = "default"):
+    """Reduce across the group, return this rank's 1/world_size slice along
+    axis 0 (reference reducescatter)."""
+    g = _manager.get(group_name)
+    reduced = allreduce(tensor, op, group_name)
+    chunks = np.array_split(np.asarray(reduced), g.world_size, axis=0)
+    return chunks[g.rank]
+
+
+def barrier(group_name: str = "default"):
+    g = _manager.get(group_name)
+    seq = _next_seq(g)
+    _barrier_inner(g, seq)
+
+
+def send(tensor, dst_rank: int, group_name: str = "default"):
+    """P2P send (reference collective.send); matched by (src, dst, seq)."""
+    g = _manager.get(group_name)
+    seq = _next_seq(g)
+    _kv_put(f"col/{g.name}/{seq}/p2p/{g.rank}->{dst_rank}",
+            pickle.dumps(tensor, protocol=5))
+
+
+def recv(src_rank: int, group_name: str = "default"):
+    g = _manager.get(group_name)
+    seq = _next_seq(g)
+    blob = _kv_wait(f"col/{g.name}/{seq}/p2p/{src_rank}->{g.rank}")
+    return pickle.loads(blob)
+
+
+# ---------------------------------------------------------------- plumbing
+def _worker():
+    w = global_worker()
+    if w is None:
+        raise RuntimeError("ray_tpu.init() must be called before collectives")
+    return w
+
+
+def _kv_put(key: str, value: bytes):
+    _worker().kv("put", ns="collective", key=key, value=value)
+
+
+def _kv_get(key: str):
+    return _worker().kv("get", ns="collective", key=key)["value"]
+
+
+def _kv_wait(key: str, timeout: float = 120.0, interval: float = 0.003) -> bytes:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = _kv_get(key)
+        if v is not None:
+            return v
+        time.sleep(interval)
+    raise TimeoutError(f"collective timeout waiting for {key}")
+
+
+def _wait_all(prefix: str, world_size: int, timeout: float = 120.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        keys = _worker().kv("keys", ns="collective", prefix=prefix)["keys"]
+        if len(keys) >= world_size:
+            return
+        time.sleep(0.003)
+    raise TimeoutError(f"collective rendezvous timeout on {prefix}")
+
+
+def _next_seq(g: _Group) -> int:
+    g.seq += 1
+    # GC this rank's keys from two rounds back: every rank has passed that
+    # round's rendezvous, so nobody can still be reading them. Keeps the
+    # controller KV bounded under per-step allreduce loops.
+    horizon = g.seq - 2
+    old = [(s, k) for (s, k) in g.written if s <= horizon]
+    g.written = [(s, k) for (s, k) in g.written if s > horizon]
+    for _, k in old:
+        try:
+            _worker().kv("del", ns="collective", key=k)
+        except Exception:
+            pass
+    return g.seq
+
+
+def _put_seq(g: _Group, seq: int, key: str, value: bytes):
+    _kv_put(key, value)
+    g.written.append((seq, key))
+
+
+def _exchange(g: _Group, seq: int, tensor) -> list:
+    """All ranks publish their contribution, then read everyone's."""
+    _put_seq(g, seq, f"col/{g.name}/{seq}/x/{g.rank}", pickle.dumps(tensor, protocol=5))
+    _wait_all(f"col/{g.name}/{seq}/x", g.world_size)
+    out = []
+    for r in range(g.world_size):
+        blob = _kv_wait(f"col/{g.name}/{seq}/x/{r}")
+        out.append(pickle.loads(blob))
+    return out
+
+
+def _barrier_inner(g: _Group, seq: int):
+    _put_seq(g, seq, f"col/{g.name}/{seq}/bar/{g.rank}", b"1")
+    _wait_all(f"col/{g.name}/{seq}/bar", g.world_size)
+
+
+def _tree_reduce(contribs: list, op: str):
+    """Reduce a list of same-structure pytrees of numpy arrays."""
+    import jax
+
+    reducer = _REDUCERS[op]
+    return jax.tree_util.tree_map(lambda *leaves: reducer(np.stack(leaves)), *contribs)

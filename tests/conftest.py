@@ -8,7 +8,9 @@ mechanism for testing multi-chip SPMD without TPU hardware.
 
 import os
 
-# Must be set before jax import anywhere in the test process tree.
+# Must be set before jax import anywhere in the test process tree. (The
+# axon-tunnel escape hatch lives in _pytest_early_env.py, loaded via
+# pytest.ini addopts before fd capture starts.)
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = (
     "--xla_force_host_platform_device_count=8 " + os.environ.get("XLA_FLAGS", "")

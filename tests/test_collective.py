@@ -1,0 +1,74 @@
+"""Host-tier collective library tests (reference
+python/ray/util/collective/tests/): groups of actors rendezvous and run
+allreduce/allgather/broadcast/barrier through the control plane."""
+
+import numpy as np
+
+import ray_tpu
+
+
+def _worker_cls():
+    @ray_tpu.remote
+    class ColWorker:
+        def __init__(self, world_size, rank, group):
+            from ray_tpu.util import collective as col
+
+            self.col = col
+            self.rank = rank
+            col.init_collective_group(world_size, rank, group)
+
+        def allreduce(self, value, group):
+            return self.col.allreduce(np.asarray(value, dtype=np.float32), group_name=group)
+
+        def allgather(self, value, group):
+            return self.col.allgather(np.asarray(value), group_name=group)
+
+        def broadcast(self, value, group):
+            return self.col.broadcast(value if self.rank == 0 else None,
+                                      src_rank=0, group_name=group)
+
+        def tree_allreduce(self, group):
+            tree = {"a": np.full((2, 2), float(self.rank)), "b": np.ones(3) * self.rank}
+            return self.col.allreduce(tree, group_name=group)
+
+        def p2p(self, group):
+            if self.rank == 0:
+                self.col.send(np.arange(4), dst_rank=1, group_name=group)
+                return None
+            return self.col.recv(src_rank=0, group_name=group)
+
+    return ColWorker
+
+
+def test_collective_allreduce_allgather(ray_start_4cpu):
+    ColWorker = _worker_cls()
+    world = 3
+    ws = [ColWorker.remote(world, r, "g1") for r in range(world)]
+    out = ray_tpu.get([w.allreduce.remote([1.0, float(i)], "g1")
+                       for i, w in enumerate(ws)], timeout=120)
+    for o in out:
+        np.testing.assert_allclose(o, [3.0, 0.0 + 1.0 + 2.0])
+    gathered = ray_tpu.get([w.allgather.remote(i * 10, "g1")
+                            for i, w in enumerate(ws)], timeout=120)
+    for g in gathered:
+        assert [int(x) for x in g] == [0, 10, 20]
+
+
+def test_collective_broadcast_and_tree(ray_start_4cpu):
+    ColWorker = _worker_cls()
+    world = 2
+    ws = [ColWorker.remote(world, r, "g2") for r in range(world)]
+    out = ray_tpu.get([w.broadcast.remote("payload-from-0", "g2") for w in ws], timeout=120)
+    assert out == ["payload-from-0", "payload-from-0"]
+    trees = ray_tpu.get([w.tree_allreduce.remote("g2") for w in ws], timeout=120)
+    for t in trees:
+        np.testing.assert_allclose(t["a"], np.full((2, 2), 1.0))  # 0 + 1
+        np.testing.assert_allclose(t["b"], np.ones(3))
+
+
+def test_collective_p2p(ray_start_4cpu):
+    ColWorker = _worker_cls()
+    ws = [_w for _w in (_worker_cls().remote(2, r, "g3") for r in range(2))]
+    out = ray_tpu.get([w.p2p.remote("g3") for w in ws], timeout=120)
+    assert out[0] is None
+    np.testing.assert_allclose(out[1], np.arange(4))
