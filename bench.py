@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""Microbenchmark suite.
+
+Parity target: reference release/microbenchmark/run_microbenchmark.py ->
+python/ray/_private/ray_perf.py. Baselines from
+release/perf_metrics/microbenchmark.json (BASELINE.md), measured on a
+64-vcpu m4.16xlarge; this runs wherever the driver puts it (often 1 vcpu),
+so vs_baseline carries the hardware gap as well.
+
+Prints ONE JSON line on stdout:
+  {"metric": "microbench_geomean", "value": <geomean of per-metric ratios
+   vs baseline>, "unit": "x_baseline", "vs_baseline": ..., "details": {...}}
+Detail rows go to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BASELINES = {
+    "single_client_tasks_sync": 963.0,
+    "single_client_tasks_async": 7293.0,
+    "1_1_actor_calls_sync": 2043.0,
+    "1_1_actor_calls_async": 8120.0,
+    "n_n_actor_calls_async": 27273.0,
+    "single_client_get_calls": 10428.0,
+    "single_client_put_calls": 4968.0,
+    "single_client_put_gigabytes": 19.4,
+}
+
+V5E_PEAK_BF16_FLOPS = 197e12  # TPU v5e peak bf16
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def timeit(name, fn, multiplier=1, min_time=2.0):
+    """reference ray_perf.py timeit: run fn repeatedly, report ops/s."""
+    fn()  # warmup
+    start = time.perf_counter()
+    count = 0
+    while time.perf_counter() - start < min_time:
+        fn()
+        count += 1
+    elapsed = time.perf_counter() - start
+    rate = count * multiplier / elapsed
+    log(f"  {name}: {rate:,.1f} /s")
+    return rate
+
+
+def main():
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=4)
+    results: dict[str, float] = {}
+
+    @ray_tpu.remote
+    def noop():
+        return None
+
+    @ray_tpu.remote
+    class Actor:
+        def noop(self):
+            return None
+
+    # Warm the pool so process startup isn't measured.
+    ray_tpu.get([noop.remote() for _ in range(8)], timeout=120)
+
+    log("tasks:")
+    results["single_client_tasks_sync"] = timeit(
+        "single client tasks sync", lambda: ray_tpu.get(noop.remote(), timeout=60))
+    results["single_client_tasks_async"] = timeit(
+        "single client tasks async",
+        lambda: ray_tpu.get([noop.remote() for _ in range(100)], timeout=120),
+        multiplier=100)
+
+    log("actor calls:")
+    a = Actor.remote()
+    ray_tpu.get(a.noop.remote(), timeout=60)
+    results["1_1_actor_calls_sync"] = timeit(
+        "1:1 actor calls sync", lambda: ray_tpu.get(a.noop.remote(), timeout=60))
+    results["1_1_actor_calls_async"] = timeit(
+        "1:1 actor calls async",
+        lambda: ray_tpu.get([a.noop.remote() for _ in range(100)], timeout=120),
+        multiplier=100)
+    actors = [Actor.options(num_cpus=0).remote() for _ in range(4)]
+    ray_tpu.get([b.noop.remote() for b in actors], timeout=60)
+    results["n_n_actor_calls_async"] = timeit(
+        "n:n actor calls async",
+        lambda: ray_tpu.get(
+            [b.noop.remote() for b in actors for _ in range(25)], timeout=120),
+        multiplier=100)
+
+    log("objects:")
+    small = b"x" * 1024
+    ref_small = ray_tpu.put(np.frombuffer(small, dtype=np.uint8))
+    results["single_client_get_calls"] = timeit(
+        "single client get calls",
+        lambda: [ray_tpu.get(ref_small, timeout=60) for _ in range(100)],
+        multiplier=100)
+    arr_small = np.frombuffer(small, dtype=np.uint8)
+    results["single_client_put_calls"] = timeit(
+        "single client put calls",
+        lambda: [ray_tpu.put(arr_small) for _ in range(100)],
+        multiplier=100)
+
+    big = np.random.randint(0, 256, size=100 * 1024 * 1024, dtype=np.uint8)
+    gb = big.nbytes / 1e9
+
+    def put_big():
+        ref = ray_tpu.put(big)
+        del ref  # decref frees the segment back to the warm pool
+
+    results["single_client_put_gigabytes"] = timeit(
+        "single client put gigabytes", put_big, multiplier=gb)
+
+    # ---- TPU matmul MFU (single chip), when a TPU is reachable -----------
+    mfu = None
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        if jax.devices()[0].platform == "tpu":
+            n = 4096
+            x = jax.random.normal(jax.random.PRNGKey(0), (n, n),
+                                  dtype=jnp.bfloat16) / (n ** 0.5)
+
+            def chain(a, iters):
+                # lax.fori_loop keeps the whole chain in ONE device program
+                # and only a scalar comes back: the long-vs-short slope
+                # isolates pure matmul time even over a slow tunnel.
+                y = jax.lax.fori_loop(0, iters, lambda i, y: y @ x, a)
+                return jnp.float32(y.sum())
+
+            f = jax.jit(chain, static_argnums=1)
+
+            def run(iters):
+                t0 = time.perf_counter()
+                float(f(x, iters))  # scalar materialization
+                return time.perf_counter() - t0
+
+            run(2)  # compile both variants ahead of timing
+            run(130)
+            t_short = min(run(2) for _ in range(3))
+            t_long = min(run(130) for _ in range(3))
+            per_matmul = (t_long - t_short) / 128
+            flops = 2 * n**3 / per_matmul
+            mfu = flops / V5E_PEAK_BF16_FLOPS
+            results["tpu_matmul_tflops"] = flops / 1e12
+            log(f"  tpu matmul: {flops/1e12:.1f} TFLOP/s ({mfu*100:.1f}% of v5e bf16 peak)")
+    except Exception as e:  # no TPU in this environment
+        log(f"  tpu matmul skipped: {e}")
+
+    ray_tpu.shutdown()
+
+    ratios = {k: results[k] / BASELINES[k] for k in BASELINES if k in results}
+    geomean = float(np.exp(np.mean([np.log(max(r, 1e-9)) for r in ratios.values()])))
+    details = {k: round(v, 1) for k, v in results.items()}
+    details["ratios"] = {k: round(r, 3) for k, r in ratios.items()}
+    if mfu is not None:
+        details["tpu_matmul_mfu"] = round(mfu, 3)
+    print(json.dumps({
+        "metric": "microbench_geomean",
+        "value": round(geomean, 4),
+        "unit": "x_baseline",
+        "vs_baseline": round(geomean, 4),
+        "details": details,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

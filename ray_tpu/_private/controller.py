@@ -101,6 +101,12 @@ class Controller:
         # that never pass through scheduling) can't leak or poison a later
         # lineage reconstruction of the same task_id.
         self.cancelled: dict[str, tuple[bool, float]] = {}
+        # task_id -> (task_done payload, expiry): completions whose task_done
+        # beat the dispatch *reply* (worker reports straight to the
+        # controller; the agent's reply rides another connection). Replayed
+        # by _dispatch_bg once the dispatch bookkeeping exists — otherwise
+        # the late-arriving entry would zombify and leak its resources.
+        self.early_done: dict[str, tuple[dict, float]] = {}
         self._sched_wakeup = asyncio.Event()
         self._tasks: list[asyncio.Task] = []
         self._stopping = False
@@ -186,14 +192,26 @@ class Controller:
         # worker acquisition cannot stall cluster-wide placement (the agent
         # may wait up to worker_register_timeout_s for a free worker).
         still_pending: deque[TaskSpec] = deque()
+        # Demand signatures that already failed to place in THIS pass: later
+        # FIFO tasks with the same shape can't place either — skip their
+        # pick_node scan (reference caches by SchedulingClass; keeps a burst
+        # of N queued tasks from costing O(N) scans per completion).
+        failed_sigs: set = set()
         while self.pending:
             spec = self.pending.popleft()
             if self._consume_cancel(spec.task_id) is not None:
                 await self._finish_cancelled(spec)
                 continue
+            sig = (tuple(sorted(spec.resources.items())), spec.strategy.kind,
+                   spec.strategy.node_id, spec.strategy.soft,
+                   spec.strategy.pg_id, spec.strategy.pg_bundle_index)
+            if sig in failed_sigs:
+                still_pending.append(spec)
+                continue
             demand = ResourceSet(_raw=spec.resources)
             nid = pick_node(demand, spec.strategy, self.nodes, self.pg_bundles)
             if nid is None:
+                failed_sigs.add(sig)
                 still_pending.append(spec)
                 continue
             self._consume(nid, spec, demand)
@@ -207,6 +225,13 @@ class Controller:
             self.pending.append(spec)
             self._kick()
             return
+        early = self.early_done.pop(spec.task_id, None)
+        if early is not None:
+            payload = dict(early[0])
+            if payload.get("attempt", 0) != spec.attempt:
+                return  # stale completion of a previous attempt: discard
+            payload["_replayed"] = True
+            await self._p_task_done(None, payload)
         # A cancel may have landed while the dispatch RPC was in flight
         # (worker still starting): deliver it now that we know the worker.
         if spec.task_id in self.cancelled:
@@ -282,12 +307,33 @@ class Controller:
         self._kick()
         return {"queued": True}
 
+    async def _p_submit_task(self, conn, a):
+        """Push variant: submitters don't need the queue ack (hot path)."""
+        await self._h_submit_task(conn, a)
+
+    async def _p_submit_batch(self, conn, a):
+        for spec in a["specs"]:
+            for oid in spec.return_object_ids():
+                ent = self.objects.setdefault(oid, _ObjectEntry())
+                ent.owner = spec.owner_id
+            self.pending.append(spec)
+        self._kick()
+
     # ------------------------------------------------------ task completion
     async def _p_task_done(self, conn, a):
         task_id = a["task_id"]
         self.cancelled.pop(task_id, None)  # completed: stale cancel marker must
         # not kill a later lineage reconstruction of the same task_id
         info = self.dispatched.pop(task_id, None)
+        if info is None and a.get("spec") is None and not a.get("_replayed"):
+            # Completion raced ahead of the dispatch reply: park it for
+            # _dispatch_bg to replay (with a TTL so duplicates can't leak).
+            now = time.monotonic()
+            for tid, (_, exp) in list(self.early_done.items()):
+                if exp < now:
+                    self.early_done.pop(tid, None)
+            self.early_done[task_id] = (a, now + 60.0)
+            return
         spec: Optional[TaskSpec] = info["spec"] if info else a.get("spec")
         if info is not None and spec.kind != ACTOR_CREATE:
             self._release(info["node_id"], spec, ResourceSet(_raw=spec.resources))

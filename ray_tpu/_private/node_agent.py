@@ -172,7 +172,11 @@ class NodeAgent:
         if method == "worker_idle":
             slot = self.workers.get(a["worker_id"])
             if slot is not None and slot.state == "busy":
-                self._worker_became_idle(slot)
+                if slot.dedicated:
+                    # One-shot worker (TPU task): the chip lease dies with it.
+                    self._kill_slot(slot)
+                else:
+                    self._worker_became_idle(slot)
 
     def _on_worker_conn_close(self, conn):
         wid = conn.meta.get("worker_id")
@@ -197,11 +201,19 @@ class NodeAgent:
         cpu = self.resources_raw.get("CPU", 0) / CONFIG.resource_unit
         return max(1, int(cpu))
 
+    @staticmethod
+    def _needs_tpu(spec: TaskSpec) -> bool:
+        return any(k.startswith("TPU") for k in (spec.resources or {}))
+
     async def _acquire_worker(self, spec: TaskSpec) -> _WorkerSlot:
         # Actors always get a dedicated fresh process (reference: dedicated
         # workers for actors, worker_pool.cc PopWorker for actor creation).
-        if spec.kind == ACTOR_CREATE:
-            slot = self._spawn_worker(spec.runtime_env, dedicated=True)
+        # TPU-requesting tasks also get a dedicated worker: only those pay
+        # the TPU-tunnel/jax plugin startup, and the chip lease dies with
+        # the process (reference: GPU workers are not reused across owners).
+        if spec.kind == ACTOR_CREATE or self._needs_tpu(spec):
+            slot = self._spawn_worker(spec.runtime_env, dedicated=True,
+                                      needs_tpu=self._needs_tpu(spec))
             await asyncio.wait_for(slot.registered.wait(), CONFIG.worker_register_timeout_s)
             return slot
         while True:
@@ -232,10 +244,17 @@ class NodeAgent:
                 fut.set_result(None)
                 break
 
-    def _spawn_worker(self, runtime_env: dict | None = None, dedicated: bool = False) -> _WorkerSlot:
+    def _spawn_worker(self, runtime_env: dict | None = None, dedicated: bool = False,
+                      needs_tpu: bool = False) -> _WorkerSlot:
         wid = WorkerID.from_random().hex()
         env = dict(os.environ)
         env.update(self.extra_env)
+        if not needs_tpu and env.get("PALLAS_AXON_POOL_IPS"):
+            # Don't pay the TPU-tunnel jax plugin registration (~2s of import
+            # at every interpreter start) in workers that didn't ask for a
+            # chip; they fall back to CPU jax if they use jax at all.
+            env["PALLAS_AXON_POOL_IPS"] = ""
+            env["JAX_PLATFORMS"] = "cpu"
         # Make sure spawned workers can import ray_tpu wherever the driver ran.
         import ray_tpu
 
@@ -327,6 +346,13 @@ def main():
     cluster_utils to start extra nodes, and by `ray-tpu start` CLI)."""
     import argparse
     import json
+    import signal
+
+    def _term(signum, frame):
+        rpc.cleanup_sockets()
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, _term)
 
     p = argparse.ArgumentParser()
     p.add_argument("--controller", required=True)

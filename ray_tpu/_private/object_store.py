@@ -29,8 +29,15 @@ class LocalStore:
         self.spill_dir = os.path.join(spill_dir, self.session)
         self.shm_dir = shm_dir
         self._lock = threading.RLock()
-        # oid -> {"size": int, "where": "shm"|"spill", "last_used": float,
-        #         "mv": memoryview|None, "mm": mmap|None, "created": bool}
+        # oid -> {"size": int, "cap": int, "where": "shm"|"spill",
+        #         "last_used": float, "mv": memoryview|None, "mm": mmap|None,
+        #         "created": bool}
+        # NOTE on reuse: freed segments must NOT be recycled for new objects.
+        # The shm namespace is host-shared — a sibling process may have the
+        # inode mapped (zero-copy reads), and deserialized arrays keep views
+        # after local release, so rewriting a recycled segment would corrupt
+        # live data. Safe recycling needs host-coordinated pinning (the
+        # plasma client-release protocol) — the planned native store.
         self._objects: dict[str, dict] = {}
         self._used = 0
 
@@ -57,6 +64,7 @@ class LocalStore:
                 mm = mmap.mmap(fd, max(total, 1))
             finally:
                 os.close(fd)
+            cap = max(total, 1)
             off = 0
             for p in parts:
                 if not isinstance(p, (bytes, bytearray)):
@@ -65,6 +73,7 @@ class LocalStore:
                 off += len(p)
             self._objects[oid] = {
                 "size": total,
+                "cap": cap,
                 "where": "shm",
                 "last_used": time.monotonic(),
                 "mm": mm,
@@ -73,6 +82,7 @@ class LocalStore:
             }
             self._used += total
             return total
+
 
     # -- read --------------------------------------------------------------
     def get(self, oid: str):
@@ -99,6 +109,7 @@ class LocalStore:
                 os.close(fd)
             self._objects[oid] = {
                 "size": size,
+                "cap": size,
                 "where": "shm",
                 "last_used": time.monotonic(),
                 "mm": mm,
@@ -151,7 +162,8 @@ class LocalStore:
         finally:
             os.close(fd)
         mm[: len(data)] = data
-        ent.update(where="shm", mm=mm, mv=memoryview(mm)[: len(data)], created=True)
+        ent.update(where="shm", mm=mm, mv=memoryview(mm)[: len(data)], created=True,
+                   cap=max(len(data), 1))
         self._used += ent["size"]
         try:
             os.unlink(self._spill_path(oid))

@@ -206,8 +206,36 @@ class Connection:
         self.closed = True
 
 
+def _uds_path(port: int) -> str:
+    return f"/tmp/rt_uds_{port}.sock"
+
+
+_created_socks: list[str] = []
+
+
+def cleanup_sockets():
+    """Unlink this process's unix-socket files. Registered atexit and called
+    from SIGTERM handlers (workers are killed with terminate(), which would
+    otherwise strand one socket file per worker in /tmp)."""
+    import os
+
+    while _created_socks:
+        try:
+            os.unlink(_created_socks.pop())
+        except OSError:
+            pass
+
+
+import atexit as _atexit  # noqa: E402
+
+_atexit.register(cleanup_sockets)
+
+
 class RpcServer:
-    """TCP server; dispatches per-connection requests/pushes to handlers."""
+    """TCP server (+ a same-host unix-socket listener on the same logical
+    port — loopback TCP costs measurably more per frame than UDS on the
+    asyncio hot path); dispatches per-connection requests/pushes to
+    handlers."""
 
     def __init__(
         self,
@@ -219,12 +247,23 @@ class RpcServer:
         self._on_push = on_push
         self._on_close = on_close
         self._server: Optional[asyncio.AbstractServer] = None
+        self._uds_server: Optional[asyncio.AbstractServer] = None
         self.connections: set[Connection] = set()
         self.port: int = 0
 
     async def start(self, host: str = "127.0.0.1", port: int = 0):
         self._server = await asyncio.start_server(self._accept, host, port)
         self.port = self._server.sockets[0].getsockname()[1]
+        try:
+            import os
+
+            path = _uds_path(self.port)
+            if os.path.exists(path):
+                os.unlink(path)
+            self._uds_server = await asyncio.start_unix_server(self._accept, path)
+            _created_socks.append(path)
+        except Exception:
+            self._uds_server = None  # TCP-only is always correct
         return self.port
 
     async def _accept(self, reader, writer):
@@ -247,6 +286,18 @@ class RpcServer:
                 await self._server.wait_closed()
             except Exception:
                 pass
+        if self._uds_server is not None:
+            self._uds_server.close()
+            try:
+                await self._uds_server.wait_closed()
+            except Exception:
+                pass
+            import os
+
+            try:
+                os.unlink(_uds_path(self.port))
+            except OSError:
+                pass
         for conn in list(self.connections):
             await conn.close()
 
@@ -259,7 +310,19 @@ async def connect(
     on_close=None,
     timeout: float = 30.0,
 ) -> Connection:
-    reader, writer = await asyncio.wait_for(asyncio.open_connection(host, port), timeout)
+    reader = writer = None
+    if host in ("127.0.0.1", "localhost"):
+        import os
+
+        path = _uds_path(port)
+        if os.path.exists(path):
+            try:
+                reader, writer = await asyncio.wait_for(
+                    asyncio.open_unix_connection(path), timeout)
+            except Exception:
+                reader = writer = None  # fall back to TCP
+    if reader is None:
+        reader, writer = await asyncio.wait_for(asyncio.open_connection(host, port), timeout)
     conn = Connection(reader, writer)
     conn.on_request = on_request
     conn.on_push = on_push

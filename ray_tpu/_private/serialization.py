@@ -144,11 +144,21 @@ def deserialize(sobj: SerializedObject, resolve_ref=None):
 
 
 def dumps_oob(value) -> tuple[bytes, list]:
-    """Plain pickle5 dump with out-of-band buffers (no ref tracking)."""
+    """Plain pickle5 dump with out-of-band buffers (no ref tracking).
+
+    Uses stdlib pickle (much faster than cloudpickle on this hot path — every
+    RPC frame goes through here); RPC payloads only contain importable types
+    (TaskSpec, primitives, bytes). User functions/closures go through
+    serialize() above, which keeps the cloudpickle pickler. Falls back to
+    cloudpickle for the rare unpicklable-by-reference value (e.g. a user
+    exception instance embedded in an error blob)."""
     buffers: list = []
-    header = cloudpickle.dumps(
-        value, protocol=5, buffer_callback=lambda pb: (buffers.append(pb.raw()), False)[1]
-    )
+    cb = lambda pb: (buffers.append(pb.raw()), False)[1]  # noqa: E731
+    try:
+        header = pickle.dumps(value, protocol=5, buffer_callback=cb)
+    except Exception:
+        buffers.clear()
+        header = cloudpickle.dumps(value, protocol=5, buffer_callback=cb)
     return header, buffers
 
 

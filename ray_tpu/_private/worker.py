@@ -170,6 +170,11 @@ class Worker:
         self._lineage: dict[str, TaskSpec] = {}  # return oid -> producing spec
         self._registered_fns: set[str] = set()
         self._fn_cache: dict[str, Any] = {}
+        import weakref
+
+        # fn -> fid, weakly keyed so dynamically created functions (and any
+        # closure state they capture) stay collectible.
+        self._fn_id_cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
         # Direct actor transport:
         self._actor_conns: dict[str, rpc.Connection] = {}
         self._actor_info: dict[str, dict] = {}
@@ -178,6 +183,8 @@ class Worker:
         # sequential_actor_submit_queue.h — per-caller ordering guarantee).
         self._actor_send_locks: dict[str, asyncio.Lock] = {}
         self._submit_lock = threading.Lock()
+        self._submit_buf: list = []
+        self._submit_flushing = False
         # Hook used by worker_proc to execute actor calls in-order:
         self.actor_call_handler = None  # async def (spec) -> reply dict
         self._shutdown = False
@@ -299,19 +306,25 @@ class Worker:
         return ObjectRef(oid, owned=True, worker=self)
 
     def _store_blob(self, oid: str, sobj: SerializedObject, register: bool) -> None:
+        """Registration is a one-way push: the owner resolves locally, and a
+        borrower's wait_object on the controller blocks until the push lands.
+        Pushes and later calls share one ordered connection, so a task
+        submitted after a put can never be scheduled before the controller
+        knows the object (removes one round trip per put — the reference
+        plasma Put is similarly fire-and-forget to the owner's local store)."""
         size = sobj.total_bytes()
         if size <= CONFIG.max_inline_object_bytes:
             parts = [sobj.to_bytes()]
             self._inline_cache[oid] = parts
             if register:
-                self.io.run(self.controller.call(
+                self.io.run(self.controller.push(
                     "register_put", oid=oid, size=size, inline=parts,
                     holder=self.server_addr, owner=self.worker_id))
         else:
             self.store.put(oid, sobj.to_parts())
             holder = self.agent_addr or self.server_addr
             if register:
-                self.io.run(self.controller.call(
+                self.io.run(self.controller.push(
                     "register_put", oid=oid, size=size, inline=None,
                     holder=holder, owner=self.worker_id))
         res = self._resolutions.setdefault(oid, _Resolution())
@@ -515,6 +528,16 @@ class Worker:
 
     # --------------------------------------------------------- submit task
     def _register_function(self, fn) -> str:
+        # Hot path: serializing the function (closure walk) costs far more
+        # than the submit itself — cache by object identity so a @remote
+        # function is pickled once per process (reference function_manager
+        # exports once per function id).
+        try:
+            fid = self._fn_id_cache.get(fn)
+        except TypeError:  # unhashable/unweakrefable callables: no cache
+            fid = None
+        if fid is not None:
+            return fid
         blob = serialize(fn, ref_class=ObjectRef)
         if blob.contained_refs:
             raise ValueError("remote function may not close over ObjectRefs; pass them as args")
@@ -525,6 +548,10 @@ class Worker:
         if fid not in self._registered_fns:
             self.io.run(self.controller.call("kv_put", ns="fn", key=fid, value=data, overwrite=False))
             self._registered_fns.add(fid)
+        try:
+            self._fn_id_cache[fn] = fid
+        except TypeError:
+            pass
         return fid
 
     def load_function(self, fid: str):
@@ -595,8 +622,40 @@ class Worker:
             if spec.max_retries != 0:
                 self._lineage[oid] = spec
             refs.append(ObjectRef(oid, owned=True, worker=self))
-        self.io.run(self.controller.call("submit_task", spec=spec))
+        # Coalesced one-way submit: bursts of .remote() calls ride one RPC
+        # frame (reference batches task submission through the Cython layer;
+        # here the flusher drains whatever accumulated while the previous
+        # frame was in flight).
+        with self._submit_lock:
+            self._submit_buf.append(spec)
+            need_flush = not self._submit_flushing
+            self._submit_flushing = True
+        if need_flush:
+            self.io.spawn(self._a_flush_submits())
         return refs
+
+    async def _a_flush_submits(self):
+        while True:
+            with self._submit_lock:
+                batch = list(self._submit_buf)
+                self._submit_buf.clear()
+                if not batch:
+                    self._submit_flushing = False
+                    return
+            try:
+                await self.controller.push("submit_batch", specs=batch)
+            except Exception as e:
+                # The push failed after the specs left the buffer: fail the
+                # batch's refs so callers see an error instead of a hang.
+                with self._submit_lock:
+                    self._submit_flushing = False
+                h, bufs = dumps_oob({"type": "WorkerCrashedError",
+                                     "message": f"task submission failed: {e}"})
+                for spec in batch:
+                    for oid in spec.return_object_ids():
+                        res = self._resolutions.setdefault(oid, _Resolution())
+                        res.resolve(None, [], [h, *bufs])
+                return
 
     # -------------------------------------------------------------- actors
     def create_actor(self, cls, args, kwargs, *, name=None, namespace="default",

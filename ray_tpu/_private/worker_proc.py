@@ -269,8 +269,8 @@ class WorkerProc:
 
         async def _report():
             await self.worker.controller.push(
-                "task_done", task_id=spec.task_id, results=results,
-                error=error_blob, retryable=False, spec=None)
+                "task_done", task_id=spec.task_id, attempt=spec.attempt,
+                results=results, error=error_blob, retryable=False, spec=None)
             if spec.kind == NORMAL:
                 await self.agent_conn.push("worker_idle", worker_id=self.worker_id)
 
@@ -331,7 +331,8 @@ class WorkerProc:
             results = self._package_results(spec, None, error_blob)
 
         async def _report():
-            payload = dict(task_id=spec.task_id, results=results, error=error_blob,
+            payload = dict(task_id=spec.task_id, attempt=spec.attempt,
+                           results=results, error=error_blob,
                            retryable=retryable, spec=None)
             if spec.kind == ACTOR_CREATE:
                 payload["actor_address"] = self.worker.server_addr
@@ -380,6 +381,13 @@ class WorkerProc:
 
 
 def main():
+    import signal
+
+    def _term(signum, frame):
+        rpc.cleanup_sockets()
+        os._exit(0)
+
+    signal.signal(signal.SIGTERM, _term)
     logging.basicConfig(level=logging.INFO, format=f"[worker %(process)d] %(message)s")
     proc = WorkerProc()
     proc.start()
