@@ -42,7 +42,20 @@ class HeadNode:
         res.update(resources or {})
         self.resources = ResourceSet(res)
         self.labels = labels or {}
-        self.worker_env = worker_env
+        self.worker_env = dict(worker_env or {})
+        # Workers must be able to unpickle by-reference functions from any
+        # module the DRIVER can import (e.g. pytest-inserted test dirs, user
+        # script dirs). For a local head, inheriting the driver's sys.path
+        # is the runtime-env equivalent of the reference's working_dir
+        # shipping (python/ray/_private/runtime_env/packaging.py).
+        import sys
+
+        # Keep zipimport entries (.egg/.zip) too; explicit user-provided
+        # PYTHONPATH stays FIRST so it can shadow inherited driver paths.
+        driver_paths = [p for p in sys.path if p and os.path.exists(p)]
+        existing = self.worker_env.get("PYTHONPATH", "")
+        self.worker_env["PYTHONPATH"] = os.pathsep.join(
+            ([existing] if existing else []) + driver_paths)
         self.io = rpc.EventLoopThread(name="rt-head")
         self.controller: Controller | None = None
         self.agent: NodeAgent | None = None

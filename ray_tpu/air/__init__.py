@@ -1,0 +1,15 @@
+"""ray_tpu.air — shared config/result surface (reference python/ray/air:
+air/config.py ScalingConfig/RunConfig/FailureConfig/CheckpointConfig,
+air/result.py Result). Canonical definitions live in ray_tpu.train."""
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import (
+    CheckpointConfig,
+    FailureConfig,
+    RunConfig,
+    ScalingConfig,
+)
+from ray_tpu.train._internal.controller import Result
+
+__all__ = ["Checkpoint", "CheckpointConfig", "FailureConfig", "RunConfig",
+           "ScalingConfig", "Result"]

@@ -1,0 +1,166 @@
+"""Train controller: drives the worker group, drains reports, applies the
+failure policy.
+
+Parity target: reference train v2 controller
+(train/v2/_internal/execution/controller/controller.py:91 TrainController,
+run:446, loop :423) with FailurePolicy (failure_policy.py:14): on a
+worker-group failure, if the policy allows, the whole group is torn down and
+restarted from the latest reported checkpoint (elastic recovery).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+import uuid
+from typing import Optional
+
+import ray_tpu
+from ray_tpu.train._internal.worker_group import WorkerGroup
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.config import FailureConfig, RunConfig, ScalingConfig
+
+logger = logging.getLogger(__name__)
+
+
+class Result:
+    """reference python/ray/air/result.py Result."""
+
+    def __init__(self, metrics: Optional[dict], checkpoint: Optional[Checkpoint],
+                 path: str, error: Optional[str] = None,
+                 metrics_history: Optional[list] = None):
+        self.metrics = metrics
+        self.checkpoint = checkpoint
+        self.path = path
+        self.error = error
+        self.metrics_history = metrics_history or []
+
+    def __repr__(self):
+        return (f"Result(metrics={self.metrics}, checkpoint={self.checkpoint}, "
+                f"error={'yes' if self.error else None})")
+
+
+class TrainController:
+    def __init__(self, *, train_fn, train_loop_config,
+                 scaling_config: ScalingConfig, run_config: RunConfig,
+                 datasets: Optional[dict] = None):
+        self.train_fn = train_fn
+        self.config = train_loop_config
+        self.scaling = scaling_config
+        self.run_config = run_config
+        self.datasets = datasets or {}
+        self.run_name = run_config.name or f"train_{uuid.uuid4().hex[:8]}"
+        self.storage_dir = os.path.join(run_config.resolved_storage(), self.run_name)
+        os.makedirs(self.storage_dir, exist_ok=True)
+        self.latest_checkpoint: Optional[Checkpoint] = None
+        self.metrics_history: list[dict] = []
+        self._checkpoint_paths: list[str] = []
+        self.failures = 0
+
+    def _split_datasets(self) -> Optional[list]:
+        if not self.datasets:
+            return None
+        n = self.scaling.num_workers
+        shards = [dict() for _ in range(n)]
+        for name, ds in self.datasets.items():
+            if hasattr(ds, "streaming_split"):
+                for rank, piece in enumerate(ds.streaming_split(n)):
+                    shards[rank][name] = piece
+            else:
+                for rank in range(n):
+                    shards[rank][name] = ds
+        return shards
+
+    def run(self) -> Result:
+        max_failures = self.run_config.failure_config.max_failures
+        attempt = 0
+        while True:
+            try:
+                group = WorkerGroup(
+                    num_workers=self.scaling.num_workers,
+                    resources_per_worker=self.scaling.worker_resources(),
+                    run_name=self.run_name,
+                    storage_dir=self.storage_dir,
+                    group_name=f"train-{self.run_name}-r{attempt}",
+                    restart_index=attempt,
+                    latest_checkpoint=self.latest_checkpoint,
+                    dataset_shards_per_worker=self._split_datasets(),
+                )
+            except Exception as e:
+                # Group start failure goes through the same failure policy
+                # as a mid-run crash (the group cleaned itself up).
+                outcome = {"status": "system_failure", "error": f"group start failed: {e!r}"}
+            else:
+                try:
+                    outcome = self._run_attempt(group)
+                finally:
+                    group.shutdown()
+            if outcome["status"] == "finished":
+                return Result(
+                    metrics=self.metrics_history[-1] if self.metrics_history else None,
+                    checkpoint=self.latest_checkpoint,
+                    path=self.storage_dir,
+                    metrics_history=self.metrics_history,
+                )
+            if outcome["status"] == "user_error":
+                return Result(
+                    metrics=self.metrics_history[-1] if self.metrics_history else None,
+                    checkpoint=self.latest_checkpoint,
+                    path=self.storage_dir,
+                    error=outcome["error"],
+                    metrics_history=self.metrics_history,
+                )
+            # system failure -> failure policy (reference failure_policy.py:14)
+            self.failures += 1
+            attempt += 1
+            if max_failures != -1 and self.failures > max_failures:
+                return Result(
+                    metrics=self.metrics_history[-1] if self.metrics_history else None,
+                    checkpoint=self.latest_checkpoint,
+                    path=self.storage_dir,
+                    error=f"training failed after {self.failures} failures: "
+                          f"{outcome['error']}",
+                    metrics_history=self.metrics_history,
+                )
+            logger.warning("train group failure %d (%s); restarting from %s",
+                           self.failures, outcome["error"], self.latest_checkpoint)
+
+    def _drain(self, group: WorkerGroup):
+        for p in group.poll():
+            for rep in p["reports"]:
+                self.metrics_history.append(rep["metrics"])
+                if rep.get("checkpoint_path"):
+                    self.latest_checkpoint = Checkpoint(rep["checkpoint_path"])
+                    self._checkpoint_paths.append(rep["checkpoint_path"])
+                    self._prune_checkpoints()
+
+    def _prune_checkpoints(self):
+        keep = self.run_config.checkpoint_config.num_to_keep
+        if not keep:
+            return
+        import shutil
+
+        while len(self._checkpoint_paths) > keep:
+            victim = self._checkpoint_paths.pop(0)
+            if self.latest_checkpoint and victim == self.latest_checkpoint.path:
+                continue
+            shutil.rmtree(victim, ignore_errors=True)
+
+    def _run_attempt(self, group: WorkerGroup) -> dict:
+        run_refs = group.run_async(self.train_fn, self.config)
+        pending = list(run_refs)
+        while pending:
+            done, pending = ray_tpu.wait(pending, num_returns=len(pending), timeout=0.2)
+            self._drain(group)
+            for ref in done:
+                try:
+                    out = ray_tpu.get(ref, timeout=30)
+                except Exception as e:  # actor/worker/system death
+                    self._drain(group)
+                    return {"status": "system_failure", "error": repr(e)}
+                if not out["ok"]:
+                    self._drain(group)
+                    return {"status": "user_error", "error": out["error"]}
+        self._drain(group)
+        return {"status": "finished"}

@@ -1,0 +1,127 @@
+"""Per-worker train session.
+
+Parity target: reference python/ray/train/_internal/session.py
+(_TrainSession:112, report:672, get_checkpoint:786, get_dataset_shard:1114).
+The session is the worker-side half of the trainer: it knows this worker's
+rank/world, buffers report() payloads for the controller to drain, persists
+checkpoints into run storage, and hands out dataset shards.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+from typing import Any, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+_session: Optional["TrainSession"] = None
+_session_lock = threading.Lock()
+
+
+class TrainContext:
+    """What user code sees via ray_tpu.train.get_context() (reference
+    train/context.py TrainContext)."""
+
+    def __init__(self, session: "TrainSession"):
+        self._s = session
+
+    def get_world_rank(self) -> int:
+        return self._s.rank
+
+    def get_world_size(self) -> int:
+        return self._s.world_size
+
+    def get_local_rank(self) -> int:
+        return self._s.local_rank
+
+    def get_node_rank(self) -> int:
+        return self._s.node_rank
+
+    def get_trial_name(self) -> str:
+        return self._s.run_name
+
+    def get_experiment_name(self) -> str:
+        return self._s.run_name
+
+    def get_storage(self) -> str:
+        return self._s.storage_dir
+
+
+class TrainSession:
+    def __init__(self, *, rank: int, world_size: int, local_rank: int,
+                 node_rank: int, run_name: str, storage_dir: str,
+                 restart_index: int, latest_checkpoint: Optional[Checkpoint],
+                 dataset_shards: Optional[dict] = None, group_name: str = "default"):
+        self.group_name = group_name
+        self.rank = rank
+        self.world_size = world_size
+        self.local_rank = local_rank
+        self.node_rank = node_rank
+        self.run_name = run_name
+        self.storage_dir = storage_dir
+        self.restart_index = restart_index
+        self.latest_checkpoint = latest_checkpoint
+        self.dataset_shards = dataset_shards or {}
+        self.reports: list[dict] = []  # drained by the controller
+        self.reports_lock = threading.Lock()
+        self.report_seq = 0
+        self.finished = False
+
+    # ------------------------------------------------------------- user API
+    def report(self, metrics: dict, checkpoint: Optional[Checkpoint] = None):
+        """reference session.py:672 — metrics to the controller; checkpoint
+        persisted rank-aware (rank 0 owns the canonical copy)."""
+        entry: dict[str, Any] = {"metrics": dict(metrics), "rank": self.rank}
+        if checkpoint is not None:
+            if self.rank == 0:
+                self.report_seq += 1
+                # Namespaced by restart attempt: a resumed run must never
+                # copytree onto an earlier attempt's checkpoint dirs.
+                dest = os.path.join(
+                    self.storage_dir, "checkpoints",
+                    f"checkpoint_r{self.restart_index}_{self.report_seq:06d}")
+                os.makedirs(os.path.dirname(dest), exist_ok=True)
+                if os.path.abspath(checkpoint.path) != dest:
+                    shutil.copytree(checkpoint.path, dest, dirs_exist_ok=True)
+                entry["checkpoint_path"] = dest
+            else:
+                self.report_seq += 1
+        with self.reports_lock:
+            self.reports.append(entry)
+
+    def get_checkpoint(self) -> Optional[Checkpoint]:
+        return self.latest_checkpoint
+
+    def get_dataset_shard(self, name: str = "train"):
+        shard = self.dataset_shards.get(name)
+        if shard is None:
+            raise KeyError(f"no dataset shard named {name!r}; pass datasets= to the trainer")
+        return shard
+
+    def drain_reports(self) -> list[dict]:
+        with self.reports_lock:
+            out = self.reports
+            self.reports = []
+        return out
+
+
+def init_session(**kw) -> TrainSession:
+    global _session
+    with _session_lock:
+        _session = TrainSession(**kw)
+    return _session
+
+
+def get_session() -> TrainSession:
+    if _session is None:
+        raise RuntimeError(
+            "No train session in this process — are you inside train_loop_per_worker?")
+    return _session
+
+
+def shutdown_session():
+    global _session
+    with _session_lock:
+        _session = None

@@ -1,0 +1,118 @@
+"""Worker group: the actor fleet a trainer runs on.
+
+Parity target: reference python/ray/train/_internal/worker_group.py
+(WorkerGroup:102, start:193, execute_async:233) + the v2 worker group
+(train/v2/_internal/execution/worker_group/worker_group.py:103).
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Optional
+
+import ray_tpu
+from ray_tpu.train._internal import session as session_mod
+
+
+@ray_tpu.remote
+class TrainWorkerActor:
+    """Hosts one training worker. max_concurrency=2 in practice (set via
+    .options at creation) so the controller can poll reports while the
+    user's train loop occupies the other thread."""
+
+    def __init__(self):
+        self._error: Optional[str] = None
+
+    def setup(self, *, rank: int, world_size: int, local_rank: int, node_rank: int,
+              run_name: str, storage_dir: str, restart_index: int,
+              latest_checkpoint, group_name: str, dataset_shards=None):
+        session_mod.init_session(
+            rank=rank, world_size=world_size, local_rank=local_rank,
+            node_rank=node_rank, run_name=run_name, storage_dir=storage_dir,
+            restart_index=restart_index, latest_checkpoint=latest_checkpoint,
+            dataset_shards=dataset_shards, group_name=group_name)
+        # Host-tier collective rendezvous for DP gradient sync across
+        # workers (role of reference _setup_torch_process_group,
+        # train/torch/config.py:66 — NCCL/GLOO init replaced by the
+        # control-plane collective group + in-program ICI collectives).
+        from ray_tpu.util import collective
+
+        collective.init_collective_group(world_size, rank, group_name)
+        return True
+
+    def run(self, train_fn, config):
+        s = session_mod.get_session()
+        try:
+            # Accept 0- or 1-arg loops (reference train_loop_per_worker
+            # signature inspection, data_parallel_trainer.py).
+            import inspect
+
+            takes_config = len(inspect.signature(train_fn).parameters) >= 1
+            result = train_fn(config) if takes_config else train_fn()
+            s.finished = True
+            return {"ok": True, "result": result}
+        except BaseException:
+            s.finished = True
+            return {"ok": False, "error": traceback.format_exc()}
+
+    def poll(self):
+        s = session_mod.get_session()
+        return {"reports": s.drain_reports(), "finished": s.finished}
+
+    def shutdown(self):
+        session_mod.shutdown_session()
+        return True
+
+
+class WorkerGroup:
+    def __init__(self, *, num_workers: int, resources_per_worker: dict,
+                 run_name: str, storage_dir: str, group_name: str,
+                 restart_index: int = 0, latest_checkpoint=None,
+                 dataset_shards_per_worker: Optional[list] = None):
+        self.num_workers = num_workers
+        self.workers = []
+        res = dict(resources_per_worker)
+        opts = {"num_cpus": res.pop("CPU", 0), "max_concurrency": 4}
+        if res.pop("TPU", 0):
+            opts["num_tpus"] = resources_per_worker["TPU"]
+        if res:
+            opts["resources"] = res
+        try:
+            for rank in range(num_workers):
+                self.workers.append(TrainWorkerActor.options(**opts).remote())
+            setup_refs = []
+            for rank, w in enumerate(self.workers):
+                shards = (dataset_shards_per_worker[rank]
+                          if dataset_shards_per_worker else None)
+                setup_refs.append(w.setup.remote(
+                    rank=rank, world_size=num_workers, local_rank=rank,
+                    node_rank=0, run_name=run_name, storage_dir=storage_dir,
+                    restart_index=restart_index, latest_checkpoint=latest_checkpoint,
+                    group_name=group_name, dataset_shards=shards))
+            ray_tpu.get(setup_refs, timeout=300)
+        except BaseException:
+            # A failed start must not strand the actors it already created.
+            self.shutdown()
+            raise
+
+    def run_async(self, train_fn, config) -> list:
+        return [w.run.remote(train_fn, config) for w in self.workers]
+
+    def poll(self) -> list[dict]:
+        """Per-worker poll; a dead worker loses only ITS reports — the
+        surviving workers' buffered metrics/checkpoints still drain."""
+        out = []
+        for ref in [w.poll.remote() for w in self.workers]:
+            try:
+                out.append(ray_tpu.get(ref, timeout=60))
+            except Exception:
+                pass
+        return out
+
+    def shutdown(self):
+        for w in self.workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        self.workers = []

@@ -1,0 +1,56 @@
+"""Directory checkpoints.
+
+Parity target: reference python/ray/train/_checkpoint.py:56 (Checkpoint =
+directory + filesystem URI; as_directory/from_directory/to_directory).
+Local filesystems only in this round; the URI seam is where GCS/S3 mounts
+via a filesystem adapter.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import tempfile
+from contextlib import contextmanager
+from typing import Optional
+
+
+class Checkpoint:
+    def __init__(self, path: str, metadata: Optional[dict] = None):
+        self.path = os.path.abspath(path)
+        self._metadata = metadata
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    def to_directory(self, dest: Optional[str] = None) -> str:
+        dest = dest or tempfile.mkdtemp(prefix="rt_ckpt_")
+        if os.path.abspath(dest) != self.path:
+            shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return dest
+
+    @contextmanager
+    def as_directory(self):
+        yield self.path
+
+    def get_metadata(self) -> dict:
+        if self._metadata is not None:
+            return self._metadata
+        meta_file = os.path.join(self.path, ".metadata.json")
+        if os.path.exists(meta_file):
+            with open(meta_file) as f:
+                return json.load(f)
+        return {}
+
+    def set_metadata(self, metadata: dict):
+        self._metadata = metadata
+        with open(os.path.join(self.path, ".metadata.json"), "w") as f:
+            json.dump(metadata, f)
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+    def __reduce__(self):
+        return (Checkpoint, (self.path, self._metadata))

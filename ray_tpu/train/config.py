@@ -1,0 +1,73 @@
+"""Train/AIR configuration dataclasses.
+
+Parity target: reference python/ray/air/config.py (ScalingConfig,
+RunConfig, FailureConfig, CheckpointConfig) and ray/train usage of them.
+TPU-native deltas: `use_tpu` + `topology` replace `use_gpu`; resources are
+expressed in the scheduler's TPU-first resource model.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class ScalingConfig:
+    """How many training workers and what each one needs
+    (reference air/config.py ScalingConfig)."""
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    resources_per_worker: Optional[dict] = None
+    #: TPU slice topology hint, e.g. "v5e-8" (scheduling label; reference
+    #: TPUAcceleratorManager pod awareness, accelerators/tpu.py:312).
+    topology: Optional[str] = None
+
+    def worker_resources(self) -> dict:
+        if self.resources_per_worker is not None:
+            return dict(self.resources_per_worker)
+        if self.use_tpu:
+            return {"CPU": 1, "TPU": 1}
+        return {"CPU": 1}
+
+
+@dataclass
+class FailureConfig:
+    """Elastic-recovery policy (reference air FailureConfig + train v2
+    FailurePolicy, failure_handling/failure_policy.py:14): on worker/node
+    failure the whole group restarts from the latest checkpoint."""
+
+    max_failures: int = 0  # 0 = fail fast; -1 = unlimited restarts
+
+
+@dataclass
+class CheckpointConfig:
+    """num_to_keep: prune all but the N most recent checkpoints (enforced by
+    the controller as reports arrive). checkpoint_frequency is accepted for
+    reference-API compatibility but NOT honored — checkpointing cadence is
+    whatever the user's train loop reports (a warning is logged if set)."""
+
+    num_to_keep: Optional[int] = None
+    checkpoint_frequency: int = 0
+
+    def __post_init__(self):
+        if self.checkpoint_frequency:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "CheckpointConfig.checkpoint_frequency is not honored; "
+                "checkpoint from your train loop via train.report(checkpoint=...)")
+
+
+@dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+
+    def resolved_storage(self) -> str:
+        return self.storage_path or os.path.join(
+            os.path.expanduser("~"), "ray_tpu_results")

@@ -1,0 +1,63 @@
+"""JAX helpers for training workers.
+
+The two-level parallelism story (SURVEY §2.4): inside a worker, pjit over
+the worker's devices with psum-over-ICI gradients (XLA inserts them from
+shardings); across workers, host-tier collective allreduce (DCN role). On a
+real multi-host slice, jax.distributed merges the levels into one global
+mesh — `global_mesh_from_distributed` is that path.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from ray_tpu.util import collective
+
+
+def _resolve_group(group_name):
+    """None -> the train session's own collective group."""
+    if group_name is not None:
+        return group_name
+    from ray_tpu.train._internal.session import get_session
+
+    return get_session().group_name
+
+
+def sync_gradients(grads, group_name: str | None = None, average: bool = True):
+    """Cross-worker gradient allreduce (host tier, numpy pytrees).
+    Plays the role of DDP's NCCL allreduce (reference
+    train/torch/config.py DDP wrap); in-worker device grads should already
+    be psum'd by the pjit program. group_name=None uses the train
+    session's group."""
+    group_name = _resolve_group(group_name)
+    host_grads = jax.tree_util.tree_map(lambda g: np.asarray(g), grads)
+    summed = collective.allreduce(host_grads, group_name=group_name)
+    world = collective.get_collective_group_size(group_name)
+    if average and world > 1:
+        summed = jax.tree_util.tree_map(lambda g: g / world, summed)
+    return summed
+
+
+def sync_metric(value: float, group_name: str | None = None) -> float:
+    group_name = _resolve_group(group_name)
+    out = collective.allreduce(np.asarray([value], dtype=np.float64),
+                               group_name=group_name)
+    return float(out[0]) / collective.get_collective_group_size(group_name)
+
+
+def broadcast_params(params, group_name: str | None = None, src_rank: int = 0):
+    """Make rank 0's initial parameters authoritative across the group."""
+    group_name = _resolve_group(group_name)
+    host = jax.tree_util.tree_map(lambda p: np.asarray(p), params)
+    return collective.broadcast(host, src_rank=src_rank, group_name=group_name)
+
+
+def global_mesh_from_distributed(axis_names=("dp",), shape=None):
+    """Multi-host path: after jax.distributed.initialize on every worker,
+    build one mesh over ALL processes' devices (reference role:
+    torch dist world; TPU-native: one GSPMD program over the slice)."""
+    devices = jax.devices()
+    if shape is None:
+        shape = (len(devices),)
+    return jax.sharding.Mesh(np.asarray(devices).reshape(shape), axis_names)

@@ -1,0 +1,131 @@
+"""JaxTrainer end-to-end (BASELINE north-star #1: DataParallelTrainer
+MNIST-MLP on 2 workers) + failure-policy restart from checkpoint.
+
+reference tests: python/ray/train/tests/test_data_parallel_trainer.py.
+"""
+
+import os
+import pickle
+import tempfile
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.train import (
+    Checkpoint,
+    FailureConfig,
+    JaxTrainer,
+    RunConfig,
+    ScalingConfig,
+)
+
+
+def mnist_train_loop(config):
+    """Synthetic-MNIST MLP: pjit over the worker's local devices, DP across
+    workers via host allreduce."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import ray_tpu.train as train
+    from ray_tpu.models.mlp import MLP, loss_fn
+    from ray_tpu.train import jax_utils
+
+    ctx = train.get_context()
+    rank, world = ctx.get_world_rank(), ctx.get_world_size()
+
+    rng = np.random.RandomState(rank)
+    x = rng.rand(config["batch"], 28, 28).astype("float32")
+    y = (rng.rand(config["batch"]) * 10).astype("int32")
+
+    model = MLP(hidden=32)
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(x[:1]))
+    start_step = 0
+    ckpt = train.get_checkpoint()
+    if ckpt is not None:
+        with open(os.path.join(ckpt.path, "state.pkl"), "rb") as f:
+            state = pickle.load(f)
+        params = state["params"]
+        start_step = state["step"] + 1
+
+    opt = optax.adam(1e-2)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def grad_step(p, batch):
+        return jax.value_and_grad(lambda q: loss_fn(model, q, batch))(p)
+
+    for step in range(start_step, config["steps"]):
+        loss, grads = grad_step(params, (jnp.asarray(x), jnp.asarray(y)))
+        grads = jax_utils.sync_gradients(grads)
+        grads = jax.tree_util.tree_map(jnp.asarray, grads)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        import optax as _o
+
+        params = _o.apply_updates(params, updates)
+        if config.get("die_at") is not None and step == config["die_at"] and rank == 0 \
+                and train.get_session().restart_index == 0:
+            os._exit(1)  # simulated worker crash (first attempt only)
+        if rank == 0:
+            with tempfile.TemporaryDirectory() as d:
+                with open(os.path.join(d, "state.pkl"), "wb") as f:
+                    pickle.dump({"params": params, "step": step}, f)
+                train.report({"loss": float(loss), "step": step},
+                             checkpoint=Checkpoint(d))
+        else:
+            train.report({"loss": float(loss), "step": step})
+    return {"final_loss": float(loss), "rank": rank}
+
+
+def test_jax_trainer_mnist_2workers(ray_start_4cpu, tmp_path):
+    trainer = JaxTrainer(
+        mnist_train_loop,
+        train_loop_config={"batch": 64, "steps": 4},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="mnist_e2e", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert result.metrics is not None and "loss" in result.metrics
+    assert result.checkpoint is not None
+    # loss decreased over training
+    losses = [m["loss"] for m in result.metrics_history if m.get("step") is not None]
+    assert losses[-1] < losses[0]
+    # checkpoint is loadable
+    with open(os.path.join(result.checkpoint.path, "state.pkl"), "rb") as f:
+        state = pickle.load(f)
+    assert state["step"] == 3
+
+
+def test_jax_trainer_failure_restart(ray_start_4cpu, tmp_path):
+    """Worker dies mid-run; FailureConfig restarts the group from the last
+    checkpoint and training completes (reference failure_policy.py:14)."""
+    trainer = JaxTrainer(
+        mnist_train_loop,
+        train_loop_config={"batch": 32, "steps": 6, "die_at": 3},
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="mnist_ft", storage_path=str(tmp_path),
+                             failure_config=FailureConfig(max_failures=2)),
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    with open(os.path.join(result.checkpoint.path, "state.pkl"), "rb") as f:
+        state = pickle.load(f)
+    assert state["step"] == 5
+    steps = [m["step"] for m in result.metrics_history]
+    assert 5 in steps and steps.count(2) >= 1  # progressed past the crash
+
+
+def test_jax_trainer_user_error_no_retry(ray_start_2cpu, tmp_path):
+    def bad_loop(config):
+        raise ValueError("intentional")
+
+    trainer = JaxTrainer(
+        bad_loop,
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="bad", storage_path=str(tmp_path),
+                             failure_config=FailureConfig(max_failures=3)),
+    )
+    result = trainer.fit()
+    assert result.error is not None and "intentional" in result.error
