@@ -1,0 +1,308 @@
+"""Streaming execution of a logical plan over the task pool.
+
+Parity target: reference python/ray/data/_internal/execution/
+streaming_executor.py:52 (pull-based streaming over an operator DAG with
+bounded in-flight work) + operators/map_operator.py:64 (task-based map) +
+logical/optimizers.py (operator fusion).
+
+v0 design: logical ops are fused into per-block transform chains
+(reference's MapOperator fusion), executed as remote tasks with a bounded
+in-flight window so a long dataset streams instead of materializing; blocks
+live in the object store between stages. All-to-all ops (repartition,
+random_shuffle, sort) are barriers, like the reference's
+AllToAllOperator/exchange.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Optional
+
+import ray_tpu
+from ray_tpu.data.block import BlockAccessor, combine_blocks
+
+# Bounded concurrent block tasks (reference backpressure_policy/:
+# concurrency caps instead of resource-based policies in v0).
+MAX_IN_FLIGHT = 16
+
+
+# ------------------------------------------------------------ logical plan
+class LogicalOp:
+    name = "op"
+
+
+class Read(LogicalOp):
+    name = "Read"
+
+    def __init__(self, blocks_fn: Callable[[], list], num_blocks: int):
+        self.blocks_fn = blocks_fn  # () -> list of block payloads or refs
+        self.num_blocks = num_blocks
+
+
+class MapRows(LogicalOp):
+    name = "Map"
+
+    def __init__(self, fn):
+        self.fn = fn
+
+
+class FlatMap(LogicalOp):
+    name = "FlatMap"
+
+    def __init__(self, fn):
+        self.fn = fn
+
+
+class Filter(LogicalOp):
+    name = "Filter"
+
+    def __init__(self, fn):
+        self.fn = fn
+
+
+class MapBatches(LogicalOp):
+    name = "MapBatches"
+
+    def __init__(self, fn, batch_size: Optional[int]):
+        self.fn = fn
+        self.batch_size = batch_size
+
+
+class Repartition(LogicalOp):
+    name = "Repartition"
+
+    def __init__(self, num_blocks: int):
+        self.num_blocks = num_blocks
+
+
+class RandomShuffle(LogicalOp):
+    name = "RandomShuffle"
+
+    def __init__(self, seed: Optional[int]):
+        self.seed = seed
+
+
+class Sort(LogicalOp):
+    name = "Sort"
+
+    def __init__(self, key, descending: bool):
+        self.key = key
+        self.descending = descending
+
+
+class Limit(LogicalOp):
+    name = "Limit"
+
+    def __init__(self, n: int):
+        self.n = n
+
+
+class Union(LogicalOp):
+    name = "Union"
+
+    def __init__(self, other_plan: list):
+        self.other_plan = other_plan
+
+
+# ------------------------------------------------------------- transforms
+def _apply_chain(block, chain):
+    """Run a fused chain of row/batch transforms over one block in-task."""
+    for kind, fn, arg in chain:
+        acc = BlockAccessor.for_block(block)
+        if kind == "map":
+            block = [fn(r) for r in acc.iter_rows()]
+        elif kind == "flat_map":
+            out = []
+            for r in acc.iter_rows():
+                out.extend(fn(r))
+            block = out
+        elif kind == "filter":
+            block = [r for r in acc.iter_rows() if fn(r)]
+        elif kind == "map_batches":
+            bs = arg or acc.num_rows() or 1
+            pieces = []
+            n = acc.num_rows()
+            for s in range(0, n, bs):
+                out = fn(acc.to_batch() if (s == 0 and bs >= n)
+                         else BlockAccessor.for_block(acc.slice(s, min(s + bs, n))).to_batch())
+                pieces.append(out)
+            block = combine_blocks(pieces) if pieces else block
+    return block
+
+
+@ray_tpu.remote
+def _transform_block(block, chain):
+    return _apply_chain(block, chain)
+
+
+@ray_tpu.remote
+def _split_block(block, sizes):
+    acc = BlockAccessor.for_block(block)
+    out, off = [], 0
+    for s in sizes:
+        out.append(acc.slice(off, off + s))
+        off += s
+    return out if len(out) > 1 else out[0]
+
+
+@ray_tpu.remote
+def _merge_blocks(*blocks):
+    return combine_blocks(list(blocks))
+
+
+@ray_tpu.remote
+def _sort_block_local(block, key, descending):
+    rows = BlockAccessor.for_block(block).to_rows()
+    kf = key if callable(key) else (lambda r, k=key: r[k] if isinstance(r, dict) else r)
+    return sorted(rows, key=kf, reverse=descending)
+
+
+# -------------------------------------------------------------- execution
+def _fuse(plan: list) -> list:
+    """Fuse consecutive per-row/batch ops into chains (reference fusion
+    rule, logical/optimizers.py)."""
+    fused: list = []
+    chain: list = []
+    for op in plan:
+        if isinstance(op, MapRows):
+            chain.append(("map", op.fn, None))
+        elif isinstance(op, FlatMap):
+            chain.append(("flat_map", op.fn, None))
+        elif isinstance(op, Filter):
+            chain.append(("filter", op.fn, None))
+        elif isinstance(op, MapBatches):
+            chain.append(("map_batches", op.fn, op.batch_size))
+        else:
+            if chain:
+                fused.append(("chain", chain))
+                chain = []
+            fused.append(("op", op))
+    if chain:
+        fused.append(("chain", chain))
+    return fused
+
+
+def _windowed_map(refs: list, chain) -> list:
+    """Submit transform tasks with a bounded in-flight window (streaming)."""
+    out = [None] * len(refs)
+    in_flight: dict = {}
+    i = 0
+    while i < len(refs) or in_flight:
+        while i < len(refs) and len(in_flight) < MAX_IN_FLIGHT:
+            out[i] = _transform_block.remote(refs[i], chain)
+            in_flight[out[i]] = i
+            i += 1
+        if in_flight:
+            done, _ = ray_tpu.wait(list(in_flight), num_returns=1, timeout=10)
+            for d in done:
+                in_flight.pop(d, None)
+    return out
+
+
+def execute(plan: list) -> list:
+    """Run the logical plan, returning block refs."""
+    assert plan and isinstance(plan[0], Read)
+    refs = [b if isinstance(b, ray_tpu.ObjectRef) else ray_tpu.put(b)
+            for b in plan[0].blocks_fn()]
+    for kind, item in _fuse(plan[1:]):
+        if kind == "chain":
+            refs = _windowed_map(refs, item)
+            continue
+        op = item
+        if isinstance(op, Repartition):
+            refs = _repartition(refs, op.num_blocks)
+        elif isinstance(op, RandomShuffle):
+            refs = _random_shuffle(refs, op.seed)
+        elif isinstance(op, Sort):
+            refs = _global_sort(refs, op.key, op.descending)
+        elif isinstance(op, Limit):
+            refs = _limit(refs, op.n)
+        elif isinstance(op, Union):
+            refs = refs + execute(op.other_plan)
+        else:
+            raise ValueError(f"unknown op {op.name}")
+    return refs
+
+
+def _block_sizes(refs: list) -> list[int]:
+    return [BlockAccessor.for_block(b).num_rows() for b in ray_tpu.get(refs, timeout=600)]
+
+
+def _repartition(refs: list, k: int) -> list:
+    """Exchange: split every block into k parts, merge part i across blocks
+    (reference planner/exchange/)."""
+    sizes = _block_sizes(refs)
+    total = sum(sizes)
+    target = [total // k + (1 if i < total % k else 0) for i in range(k)]
+    # Assign row ranges to output partitions.
+    splits_per_block = []
+    t_i, t_left = 0, target[0] if target else 0
+    for s in sizes:
+        parts = []
+        left = s
+        while left > 0:
+            take = min(left, t_left) if t_left else left
+            parts.append((t_i, take))
+            left -= take
+            t_left -= take
+            while t_left == 0 and t_i < k - 1:
+                t_i += 1
+                t_left = target[t_i]
+        splits_per_block.append(parts)
+    pieces: dict[int, list] = {i: [] for i in range(k)}
+    for ref, parts in zip(refs, splits_per_block):
+        if len(parts) == 1:
+            pieces[parts[0][0]].append(ref)
+            continue
+        split_ref = _split_block.options(num_returns=1).remote(ref, [p[1] for p in parts])
+        sub = ray_tpu.get(split_ref, timeout=600)
+        for (pi, _), piece in zip(parts, sub if isinstance(sub, list) else [sub]):
+            pieces[pi].append(ray_tpu.put(piece))
+    return [_merge_blocks.remote(*pieces[i]) if len(pieces[i]) != 1 else pieces[i][0]
+            for i in range(k) if pieces[i]]
+
+
+def _random_shuffle(refs: list, seed) -> list:
+    rows_refs = refs
+    blocks = ray_tpu.get(rows_refs, timeout=600)
+    all_rows = []
+    for b in blocks:
+        all_rows.extend(BlockAccessor.for_block(b).to_rows())
+    rng = random.Random(seed)
+    rng.shuffle(all_rows)
+    k = max(1, len(refs))
+    n = len(all_rows)
+    out = []
+    per = n // k + (1 if n % k else 0)
+    for s in range(0, n, per or 1):
+        out.append(ray_tpu.put(all_rows[s:s + per]))
+    return out
+
+
+def _global_sort(refs: list, key, descending) -> list:
+    sorted_refs = [_sort_block_local.remote(r, key, descending) for r in refs]
+    blocks = ray_tpu.get(sorted_refs, timeout=600)
+    import heapq
+
+    kf = key if callable(key) else (lambda r, k=key: r[k] if isinstance(r, dict) else r)
+    merged = list(heapq.merge(*blocks, key=kf, reverse=descending))
+    k = max(1, len(refs))
+    per = len(merged) // k + (1 if len(merged) % k else 0)
+    return [ray_tpu.put(merged[s:s + per]) for s in range(0, len(merged), per or 1)]
+
+
+def _limit(refs: list, n: int) -> list:
+    out, have = [], 0
+    for ref in refs:
+        if have >= n:
+            break
+        block = ray_tpu.get(ref, timeout=600)
+        acc = BlockAccessor.for_block(block)
+        r = acc.num_rows()
+        if have + r <= n:
+            out.append(ref)
+            have += r
+        else:
+            out.append(ray_tpu.put(acc.slice(0, n - have)))
+            have = n
+    return out

@@ -1,0 +1,179 @@
+"""Dataset: lazy logical plan over distributed blocks.
+
+Parity target: reference python/ray/data/dataset.py:158 (Dataset — lazy
+logical plan -> physical operators), iterator APIs
+(iterator.py DataIterator), streaming_split feeding trainers
+(reference _internal/execution/streaming_executor.py + train integration
+session.py:1114 get_dataset_shard).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.data import _internal
+from ray_tpu.data.block import BlockAccessor
+from ray_tpu.data._internal import executor as ex
+
+
+class Dataset:
+    def __init__(self, plan: list):
+        self._plan = plan
+        self._cached_refs: Optional[list] = None
+
+    # ----------------------------------------------------------- transforms
+    def _extend(self, op) -> "Dataset":
+        return Dataset(self._plan + [op])
+
+    def map(self, fn: Callable) -> "Dataset":
+        return self._extend(ex.MapRows(fn))
+
+    def flat_map(self, fn: Callable) -> "Dataset":
+        return self._extend(ex.FlatMap(fn))
+
+    def filter(self, fn: Callable) -> "Dataset":
+        return self._extend(ex.Filter(fn))
+
+    def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None) -> "Dataset":
+        return self._extend(ex.MapBatches(fn, batch_size))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._extend(ex.Repartition(num_blocks))
+
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        return self._extend(ex.RandomShuffle(seed))
+
+    def sort(self, key=None, descending: bool = False) -> "Dataset":
+        return self._extend(ex.Sort(key, descending))
+
+    def limit(self, n: int) -> "Dataset":
+        return self._extend(ex.Limit(n))
+
+    def union(self, other: "Dataset") -> "Dataset":
+        return self._extend(ex.Union(other._plan))
+
+    # ---------------------------------------------------------- execution
+    def materialize(self) -> "Dataset":
+        """Execute the plan now; the result holds resolved block refs
+        (reference Dataset.materialize -> MaterializedDataset)."""
+        refs = self._block_refs()
+        out = Dataset([ex.Read(lambda: refs, len(refs))])
+        out._cached_refs = refs
+        return out
+
+    def _block_refs(self) -> list:
+        if self._cached_refs is None:
+            self._cached_refs = ex.execute(self._plan)
+        return self._cached_refs
+
+    # --------------------------------------------------------- consumption
+    def take(self, n: int = 20) -> list:
+        out = []
+        for ref in self._block_refs():
+            block = ray_tpu.get(ref, timeout=600)
+            for row in BlockAccessor.for_block(block).iter_rows():
+                out.append(row)
+                if len(out) >= n:
+                    return out
+        return out
+
+    def take_all(self) -> list:
+        return self.take(n=1 << 62)
+
+    def count(self) -> int:
+        total = 0
+        for ref in self._block_refs():
+            total += BlockAccessor.for_block(ray_tpu.get(ref, timeout=600)).num_rows()
+        return total
+
+    def num_blocks(self) -> int:
+        return len(self._block_refs())
+
+    def schema(self):
+        refs = self._block_refs()
+        if not refs:
+            return None
+        return BlockAccessor.for_block(ray_tpu.get(refs[0], timeout=600)).schema()
+
+    def iter_rows(self) -> Iterable[Any]:
+        for ref in self._block_refs():
+            yield from BlockAccessor.for_block(ray_tpu.get(ref, timeout=600)).iter_rows()
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: str = "numpy") -> Iterable[dict]:
+        """Stream column-dict batches (reference iter_batches)."""
+        it = DataIterator(self._block_refs())
+        yield from it.iter_batches(batch_size=batch_size, batch_format=batch_format)
+
+    def to_numpy(self, column: Optional[str] = None):
+        batches = list(self.iter_batches(batch_size=1 << 30))
+        from ray_tpu.data.block import combine_blocks
+
+        merged = combine_blocks(batches) if batches else {}
+        if column is not None:
+            return merged[column]
+        if set(merged.keys()) == {"item"}:
+            return merged["item"]
+        return merged
+
+    def streaming_split(self, n: int, *, equal: bool = True) -> list["DataIterator"]:
+        """Split into n iterators for n training workers (reference
+        Dataset.streaming_split feeding get_dataset_shard)."""
+        refs = self._block_refs()
+        if len(refs) < n:
+            refs = ex._repartition(refs, n)
+        shards: list[list] = [[] for _ in range(n)]
+        for i, ref in enumerate(refs):
+            shards[i % n].append(ref)
+        return [DataIterator(s) for s in shards]
+
+    def split(self, n: int) -> list["Dataset"]:
+        return [Dataset([ex.Read(lambda s=s: list(s._refs), len(s._refs))])
+                for s in self.streaming_split(n)]
+
+    def __repr__(self):
+        names = [type(op).__name__ for op in self._plan]
+        return f"Dataset(plan={' -> '.join(names)})"
+
+
+class DataIterator:
+    """Per-consumer block iterator (reference python/ray/data/iterator.py
+    DataIterator). Picklable: holds object refs, so it can be shipped to a
+    training worker and consumed there."""
+
+    def __init__(self, refs: list):
+        self._refs = list(refs)
+
+    def iter_batches(self, *, batch_size: int = 256, batch_format: str = "numpy",
+                     drop_last: bool = False) -> Iterable[dict]:
+        carry: Optional[dict] = None
+        from ray_tpu.data.block import combine_blocks
+
+        for ref in self._refs:
+            block = ray_tpu.get(ref, timeout=600)
+            batch = BlockAccessor.for_block(block).to_batch()
+            if carry:
+                batch = combine_blocks([carry, batch])
+                carry = None
+            n = len(next(iter(batch.values()))) if batch else 0
+            s = 0
+            while n - s >= batch_size:
+                yield {k: v[s:s + batch_size] for k, v in batch.items()}
+                s += batch_size
+            if s < n:
+                carry = {k: v[s:] for k, v in batch.items()}
+        if carry and not drop_last:
+            yield carry
+
+    def iter_rows(self) -> Iterable[Any]:
+        for ref in self._refs:
+            yield from BlockAccessor.for_block(ray_tpu.get(ref, timeout=600)).iter_rows()
+
+    def materialize(self) -> "Dataset":
+        return Dataset([ex.Read(lambda: list(self._refs), len(self._refs))])
+
+    def __reduce__(self):
+        return (DataIterator, (self._refs,))
