@@ -298,8 +298,18 @@ class Controller:
             ent.resources_held = True
         return True
 
+    @staticmethod
+    def _ingest_spec(conn, spec: TaskSpec) -> TaskSpec:
+        """Over the in-process transport the submitter's LIVE spec arrives;
+        the controller mutates accepted specs (attempt, max_retries,
+        pg_bundle_index), so take a private copy. RPC connections already
+        deliver fresh unpickled copies."""
+        if isinstance(conn, rpc.LocalConnection):
+            return spec.clone()
+        return spec
+
     async def _h_submit_task(self, conn, a):
-        spec: TaskSpec = a["spec"]
+        spec = self._ingest_spec(conn, a["spec"])
         for oid in spec.return_object_ids():
             ent = self.objects.setdefault(oid, _ObjectEntry())
             ent.owner = spec.owner_id
@@ -313,6 +323,7 @@ class Controller:
 
     async def _p_submit_batch(self, conn, a):
         for spec in a["specs"]:
+            spec = self._ingest_spec(conn, spec)
             for oid in spec.return_object_ids():
                 ent = self.objects.setdefault(oid, _ObjectEntry())
                 ent.owner = spec.owner_id
@@ -547,7 +558,7 @@ class Controller:
 
     # ------------------------------------------------------------- actors
     async def _h_create_actor(self, conn, a):
-        spec: TaskSpec = a["spec"]
+        spec = self._ingest_spec(conn, a["spec"])
         if spec.actor_name:
             key = (spec.namespace, spec.actor_name)
             existing = self.named_actors.get(key)

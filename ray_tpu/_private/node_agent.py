@@ -115,11 +115,7 @@ class NodeAgent:
     async def _on_ctrl_push(self, conn, method, a):
         if method == "free":
             for oid in a["oids"]:
-                self.store.delete(oid)
-                try:
-                    os.unlink(self.store._path(oid))
-                except FileNotFoundError:
-                    pass
+                self.store.purge(oid)
         elif method == "kill_worker":
             slot = self.workers.get(a["worker_id"])
             if slot is not None:

@@ -31,15 +31,24 @@ class LocalStore:
         self._lock = threading.RLock()
         # oid -> {"size": int, "cap": int, "where": "shm"|"spill",
         #         "last_used": float, "mv": memoryview|None, "mm": mmap|None,
-        #         "created": bool}
-        # NOTE on reuse: freed segments must NOT be recycled for new objects.
-        # The shm namespace is host-shared — a sibling process may have the
-        # inode mapped (zero-copy reads), and deserialized arrays keep views
-        # after local release, so rewriting a recycled segment would corrupt
-        # live data. Safe recycling needs host-coordinated pinning (the
-        # plasma client-release protocol) — the planned native store.
+        #         "created": bool, "pin": str|None}
         self._objects: dict[str, dict] = {}
         self._used = 0
+        # Warm-segment pool (the reference gets this from plasma's dlmalloc
+        # arena: freed memory is re-handed to the next Create without giving
+        # pages back to the kernel — cold tmpfs page faults cost ~4x warm
+        # memcpy). Recycling a host-shared segment is only safe when no other
+        # process can still read it, so readers hardlink a `.p{pid}` pin next
+        # to the primary file before attaching; at free time the owner renames
+        # the primary away (no new pins possible) and recycles only when
+        # st_nlink shows no pins and the local memoryview releases cleanly.
+        self._pool: list[dict] = []  # {"cap", "path", "mm"}
+        self._pool_bytes = 0
+        self._spare_seq = 0
+        # Pins are named per (pid, store instance): two stores in one process
+        # (driver + head agent share a process in local mode) must not share
+        # a pin, or one store's clean delete would strip the other's guard.
+        self._uid = f"{os.getpid()}x{id(self) & 0xFFFF:x}"
 
     # -- naming ------------------------------------------------------------
     def _path(self, oid: str) -> str:
@@ -49,6 +58,30 @@ class LocalStore:
         return os.path.join(self.spill_dir, oid)
 
     # -- write -------------------------------------------------------------
+    def _take_spare(self, total: int):
+        """Best-fit warm segment with cap in [total, 4*total+1MB]."""
+        best = None
+        for i, sp in enumerate(self._pool):
+            if total <= sp["cap"] <= 4 * total + (1 << 20):
+                if best is None or sp["cap"] < self._pool[best]["cap"]:
+                    best = i
+        if best is None:
+            return None
+        sp = self._pool.pop(best)
+        self._pool_bytes -= sp["cap"]
+        return sp
+
+    def _drop_spare(self, sp: dict):
+        """Unlink+close a spare already removed (and deducted) from the pool."""
+        try:
+            os.unlink(sp["path"])
+        except OSError:
+            pass
+        try:
+            sp["mm"].close()
+        except (BufferError, ValueError):
+            pass
+
     def put(self, oid: str, parts: list) -> int:
         """Write a flattened object blob (list of bytes-like) into shm.
         Returns total size. Idempotent per oid."""
@@ -56,21 +89,57 @@ class LocalStore:
         with self._lock:
             if oid in self._objects:
                 return self._objects[oid]["size"]
-            self._maybe_evict(total)
             path = self._path(oid)
-            fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_TRUNC, 0o600)
-            try:
-                os.ftruncate(fd, max(total, 1))
-                mm = mmap.mmap(fd, max(total, 1))
-            finally:
-                os.close(fd)
+            mm = None
             cap = max(total, 1)
+            # Take a spare BEFORE evicting: reuse adds no net pages, so under
+            # pressure the warm segment must not be the eviction victim.
+            sp = self._take_spare(cap)
+            self._maybe_evict(total)
+            if sp is not None:
+                try:
+                    # Grow the (possibly shrunk) spare back to this object's
+                    # size; write the data while it is still at the spare
+                    # name, and only then rename — a sibling attach must
+                    # never observe the previous object's bytes under the
+                    # new oid (attachers probe /dev/shm with no lock).
+                    if sp["cap"] != cap:
+                        os.truncate(sp["path"], cap)
+                    mm = sp["mm"]
+                except OSError:
+                    self._drop_spare(sp)
+                    sp = None
+            if mm is None:
+                fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_TRUNC, 0o600)
+                try:
+                    os.ftruncate(fd, cap)
+                    mm = mmap.mmap(fd, cap)
+                finally:
+                    os.close(fd)
             off = 0
             for p in parts:
                 if not isinstance(p, (bytes, bytearray)):
                     p = memoryview(p).cast("B")  # write raw buffer, no copy
                 mm[off : off + len(p)] = p
                 off += len(p)
+            if sp is not None:
+                try:
+                    os.rename(sp["path"], path)
+                except OSError:
+                    # Lost the race with a session purge: fall back cold.
+                    self._drop_spare(sp)
+                    fd = os.open(path, os.O_CREAT | os.O_RDWR | os.O_TRUNC, 0o600)
+                    try:
+                        os.ftruncate(fd, cap)
+                        mm = mmap.mmap(fd, cap)
+                    finally:
+                        os.close(fd)
+                    off = 0
+                    for p in parts:
+                        if not isinstance(p, (bytes, bytearray)):
+                            p = memoryview(p).cast("B")
+                        mm[off : off + len(p)] = p
+                        off += len(p)
             self._objects[oid] = {
                 "size": total,
                 "cap": cap,
@@ -79,9 +148,23 @@ class LocalStore:
                 "mm": mm,
                 "mv": memoryview(mm)[:total],
                 "created": True,
+                "pin": None,
             }
             self._used += total
             return total
+
+    def detach(self, oid: str) -> None:
+        """Drop our mapping but leave the file for other readers (used by
+        executing workers after storing task results: the agent is the
+        advertised holder, so keeping the producer's mapping alive would pin
+        freed pages until the worker exits)."""
+        with self._lock:
+            ent = self._objects.pop(oid, None)
+            if ent is None or ent["where"] != "shm":
+                return
+            if ent["created"]:
+                self._used -= ent["size"]
+            self._release_mapping(ent)
 
 
     # -- read --------------------------------------------------------------
@@ -96,11 +179,32 @@ class LocalStore:
                 if ent["where"] == "shm":
                     return ent["mv"]
                 return self._restore(oid, ent)
-            # try attach (created by a sibling process on this host)
+            # Attach a segment created by a sibling process on this host.
+            # The pin hardlink (created BEFORE opening) tells the creator's
+            # free path that this segment must not be recycled; link() on a
+            # path the owner already renamed away fails -> no stale attach.
             path = self._path(oid)
+            pin = f"{path}.p{self._uid}"
+            try:
+                os.link(path, pin)
+            except FileExistsError:
+                # Stale pin from an earlier attach by this store (possibly
+                # referencing a pre-spill inode): re-link so the pin is
+                # guaranteed to name the CURRENT primary inode.
+                try:
+                    os.unlink(pin)
+                    os.link(path, pin)
+                except OSError:
+                    return None
+            except OSError:
+                return None
             try:
                 fd = os.open(path, os.O_RDONLY)
             except FileNotFoundError:
+                try:
+                    os.unlink(pin)
+                except OSError:
+                    pass
                 return None
             try:
                 size = os.fstat(fd).st_size
@@ -115,6 +219,7 @@ class LocalStore:
                 "mm": mm,
                 "mv": memoryview(mm),
                 "created": False,
+                "pin": pin,
             }
             return self._objects[oid]["mv"]
 
@@ -126,6 +231,13 @@ class LocalStore:
 
     # -- spill/restore -----------------------------------------------------
     def _maybe_evict(self, incoming: int) -> None:
+        if self._used + self._pool_bytes + incoming <= self.capacity:
+            return
+        # Spares are instantly reclaimable: drain the pool before spilling.
+        while self._pool and self._used + self._pool_bytes + incoming > self.capacity:
+            sp = self._pool.pop(0)
+            self._pool_bytes -= sp["cap"]
+            self._drop_spare(sp)
         if self._used + incoming <= self.capacity:
             return
         victims = sorted(
@@ -173,37 +285,98 @@ class LocalStore:
 
     # -- delete ------------------------------------------------------------
     @staticmethod
-    def _release_mapping(ent: dict) -> None:
+    def _release_mapping(ent: dict) -> bool:
+        """Release the local view+mapping; True if fully released (no live
+        deserialized views)."""
+        clean = True
         if ent.get("mv") is not None:
             try:
                 ent["mv"].release()
+                ent["mv"] = None
             except BufferError:
-                pass  # a deserialized array still views it; mmap stays alive
-            ent["mv"] = None
-        if ent.get("mm") is not None:
+                clean = False  # a deserialized array still views it
+        if clean and ent.get("mm") is not None:
             try:
                 ent["mm"].close()
+                ent["mm"] = None
             except BufferError:
+                clean = False
+        return clean
+
+    def _unlink_pins(self, oid: str) -> None:
+        import glob as _glob
+
+        for p in _glob.glob(self._path(oid) + ".p*"):
+            try:
+                os.unlink(p)
+            except OSError:
                 pass
-            ent["mm"] = None
 
     def delete(self, oid: str) -> None:
         with self._lock:
             ent = self._objects.pop(oid, None)
             if ent is None:
                 return
-            if ent["where"] == "shm":
-                if ent["created"]:
-                    self._used -= ent["size"]
-                    try:
-                        os.unlink(self._path(oid))
-                    except FileNotFoundError:
-                        pass
-            else:
+            if ent["where"] != "shm":
                 try:
                     os.unlink(self._spill_path(oid))
                 except FileNotFoundError:
                     pass
+                self._release_mapping(ent)
+                return
+            if not ent["created"]:
+                # Attached copy: drop our pin only once no local views remain
+                # (a live pin keeps the creator from recycling under us).
+                if self._release_mapping(ent) and ent.get("pin"):
+                    try:
+                        os.unlink(ent["pin"])
+                    except OSError:
+                        pass
+                return
+            self._used -= ent["size"]
+            path = self._path(oid)
+            # Recycle: possible only if no local views remain. Rename the
+            # primary away first (atomically stops new pins), then st_nlink
+            # == 1 proves no reader ever pinned it.
+            mv_clean = True
+            if ent.get("mv") is not None:
+                try:
+                    ent["mv"].release()
+                    ent["mv"] = None
+                except BufferError:
+                    mv_clean = False
+            if mv_clean and ent.get("mm") is not None and len(self._pool) < 32 \
+                    and self._pool_bytes + ent["cap"] <= self.capacity // 2:
+                self._spare_seq += 1
+                spare = os.path.join(
+                    self.shm_dir, f"rt_{self.session}_sp{os.getpid()}_{self._spare_seq}")
+                try:
+                    os.rename(path, spare)
+                except OSError:
+                    self._release_mapping(ent)  # purged by another process
+                    return
+                try:
+                    pinned = os.stat(spare).st_nlink != 1
+                except OSError:
+                    pinned = True
+                if not pinned:
+                    self._pool.append({"cap": ent["cap"], "path": spare, "mm": ent["mm"]})
+                    self._pool_bytes += ent["cap"]
+                    return
+                try:
+                    os.unlink(spare)
+                except OSError:
+                    pass
+                self._unlink_pins(oid)
+                self._release_mapping(ent)
+                return
+            # Not recyclable: free the names; pinned/viewing readers keep the
+            # inode alive through their own mappings.
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+            self._unlink_pins(oid)
             self._release_mapping(ent)
 
     def used_bytes(self) -> int:
@@ -212,7 +385,30 @@ class LocalStore:
     def num_objects(self) -> int:
         return len(self._objects)
 
+    def purge(self, oid: str) -> None:
+        """Remove an object's file names (primary + reader pins) whether or
+        not this store holds an entry — used by the node agent on `free`
+        pushes for segments created by its (possibly exited) workers."""
+        with self._lock:
+            if oid in self._objects:
+                self.delete(oid)
+                return
+            try:
+                os.unlink(self._path(oid))
+            except OSError:
+                pass
+            self._unlink_pins(oid)
+
     def shutdown(self) -> None:
         with self._lock:
-            for oid in list(self._objects):
+            for oid, ent in list(self._objects.items()):
+                if ent.get("pin"):
+                    try:
+                        os.unlink(ent["pin"])  # process exiting; views moot
+                    except OSError:
+                        pass
                 self.delete(oid)
+            while self._pool:
+                sp = self._pool.pop()
+                self._pool_bytes -= sp["cap"]
+                self._drop_spare(sp)

@@ -27,6 +27,20 @@ class RpcError(Exception):
     pass
 
 
+def _log_push_failure(f):
+    """Done-callback for fire-and-forget pushes: peer-close races are benign,
+    anything else (unpicklable payload, write error) must be surfaced — the
+    consumer of the lost message would otherwise just hang."""
+    if f.cancelled():
+        return
+    exc = f.exception()
+    if exc is not None and not isinstance(
+            exc, (ConnectionClosed, ConnectionResetError, BrokenPipeError)):
+        import logging
+
+        logging.getLogger(__name__).warning("fire-and-forget push failed: %r", exc)
+
+
 class ConnectionClosed(RpcError):
     pass
 
@@ -87,8 +101,10 @@ class Connection:
         self.closed = False
         self.meta: dict = {}  # server-side: who is this peer (set by register)
         self._read_task: Optional[asyncio.Task] = None
+        self.loop: Optional[asyncio.AbstractEventLoop] = None
 
     def start(self):
+        self.loop = asyncio.get_running_loop()
         self._read_task = asyncio.ensure_future(self._read_loop())
 
     @property
@@ -143,6 +159,17 @@ class Connection:
 
     async def push(self, method: str, **payload):
         await self._write({"k": "push", "m": method, "a": payload})
+
+    def push_threadsafe(self, method: str, **payload):
+        """Fire-and-forget push usable from ANY thread. Enqueued onto the
+        connection's loop via call_soon_threadsafe, which is FIFO per calling
+        thread — so pushes issued before a later call() from the same thread
+        are written to the socket first (the ordering the put->submit fast
+        path relies on). Saves the ~2 thread handoffs of io.run(push(...))."""
+        if self.loop is None:
+            raise RpcError("connection not started")
+        fut = asyncio.run_coroutine_threadsafe(self.push(method, **payload), self.loop)
+        fut.add_done_callback(_log_push_failure)
 
     async def _handle_request(self, msg: dict):
         rid = msg["id"]
@@ -206,8 +233,39 @@ class Connection:
         self.closed = True
 
 
-def _uds_path(port: int) -> str:
-    return f"/tmp/rt_uds_{port}.sock"
+def _uds_dir() -> Optional[str]:
+    """Per-user 0700 directory for unix sockets (round-2 advisor finding:
+    predictable world-writable /tmp paths let another local user pre-create a
+    socket and serve pickled replies = code execution; reference Ray keeps
+    sockets in a per-session user-owned dir). Both the server (create) and the
+    client (connect) verify the directory is a non-symlink dir owned by this
+    uid with mode 0700 — anything else disables the UDS fast path (TCP-only
+    is always correct)."""
+    import os
+    import stat
+
+    path = f"/tmp/rt_uds_{os.geteuid()}"
+    try:
+        os.mkdir(path, 0o700)
+    except FileExistsError:
+        pass
+    except OSError:
+        return None
+    try:
+        st = os.lstat(path)
+    except OSError:
+        return None
+    if (not stat.S_ISDIR(st.st_mode) or st.st_uid != os.geteuid()
+            or stat.S_IMODE(st.st_mode) != 0o700):
+        return None
+    return path
+
+
+def _uds_path(port: int) -> Optional[str]:
+    d = _uds_dir()
+    if d is None:
+        return None
+    return f"{d}/{port}.sock"
 
 
 _created_socks: list[str] = []
@@ -248,19 +306,25 @@ class RpcServer:
         self._on_close = on_close
         self._server: Optional[asyncio.AbstractServer] = None
         self._uds_server: Optional[asyncio.AbstractServer] = None
-        self.connections: set[Connection] = set()
+        self.connections: set = set()
         self.port: int = 0
+        self.loop: Optional[asyncio.AbstractEventLoop] = None
 
     async def start(self, host: str = "127.0.0.1", port: int = 0):
         self._server = await asyncio.start_server(self._accept, host, port)
         self.port = self._server.sockets[0].getsockname()[1]
+        self.loop = asyncio.get_running_loop()
+        _LOCAL_SERVERS[self.port] = self
         try:
             import os
 
             path = _uds_path(self.port)
+            if path is None:
+                raise OSError("no private uds dir")
             if os.path.exists(path):
                 os.unlink(path)
             self._uds_server = await asyncio.start_unix_server(self._accept, path)
+            os.chmod(path, 0o600)
             _created_socks.append(path)
         except Exception:
             self._uds_server = None  # TCP-only is always correct
@@ -280,6 +344,8 @@ class RpcServer:
             self._on_close(conn)
 
     async def stop(self):
+        if _LOCAL_SERVERS.get(self.port) is self:
+            del _LOCAL_SERVERS[self.port]
         if self._server is not None:
             self._server.close()
             try:
@@ -294,12 +360,128 @@ class RpcServer:
                 pass
             import os
 
-            try:
-                os.unlink(_uds_path(self.port))
-            except OSError:
-                pass
+            path = _uds_path(self.port)
+            if path is not None:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
         for conn in list(self.connections):
             await conn.close()
+
+
+# port -> RpcServer hosted by THIS process. Lets connect() bypass sockets and
+# serialization entirely for same-process peers (driver <-> controller <->
+# head agent share one process in local mode — cf. bootstrap.HeadNode). The
+# reference gets the same effect from its in-process CoreWorkerMemoryStore and
+# direct C++ calls between colocated components.
+_LOCAL_SERVERS: dict[int, "RpcServer"] = {}
+
+
+class LocalConnection:
+    """In-process peer link with Connection's API but no sockets/pickling.
+
+    Messages are delivered as live Python objects via call_soon_threadsafe
+    (FIFO per sending thread — same ordering contract as a socket write).
+    Handlers MUST treat received payloads as read-only, which they already do
+    for the RPC path (payloads there are fresh unpickled copies)."""
+
+    def __init__(self, loop: asyncio.AbstractEventLoop):
+        self.loop = loop  # loop this endpoint's callbacks run on
+        self.peer: Optional["LocalConnection"] = None
+        self.on_request: Optional[Callable] = None
+        self.on_push: Optional[Callable] = None
+        self.on_close: Optional[Callable] = None
+        self.closed = False
+        self.meta: dict = {}
+
+    @property
+    def peername(self):
+        return ("local", id(self.peer))
+
+    # -- outgoing ---------------------------------------------------------
+    def _deliver(self, kind: str, method: str, payload: dict, reply_to=None):
+        peer = self.peer
+        if peer is None or peer.closed:
+            raise ConnectionClosed("local peer went away")
+        peer.loop.call_soon_threadsafe(peer._dispatch, kind, method, payload, reply_to)
+
+    async def call(self, method: str, _timeout: float | None = None, **payload):
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._deliver("req", method, payload, (asyncio.get_running_loop(), fut))
+        if _timeout is not None:
+            return await asyncio.wait_for(fut, _timeout)
+        return await fut
+
+    async def call_start(self, method: str, **payload) -> asyncio.Future:
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._deliver("req", method, payload, (asyncio.get_running_loop(), fut))
+        return fut
+
+    async def push(self, method: str, **payload):
+        self._deliver("push", method, payload)
+
+    def push_threadsafe(self, method: str, **payload):
+        self._deliver("push", method, payload)
+
+    # -- incoming (runs on self.loop) -------------------------------------
+    def _dispatch(self, kind: str, method: str, payload: dict, reply_to):
+        if self.closed:
+            if reply_to is not None:
+                loop, fut = reply_to
+                loop.call_soon_threadsafe(_fut_set_exc, fut, ConnectionClosed("local peer closed"))
+            return
+        asyncio.ensure_future(self._run_handler(kind, method, payload, reply_to))
+
+    async def _run_handler(self, kind: str, method: str, payload: dict, reply_to):
+        if kind == "push":
+            if self.on_push is not None:
+                try:
+                    await self.on_push(self, method, payload)
+                except Exception:
+                    traceback.print_exc()
+            return
+        try:
+            if self.on_request is None:
+                raise RpcError("no request handler installed")
+            value = await self.on_request(self, method, payload)
+            err = None
+        except Exception:
+            value = None
+            err = RemoteCallError(method, traceback.format_exc())
+        loop, fut = reply_to
+        if err is None:
+            loop.call_soon_threadsafe(_fut_set_result, fut, value)
+        else:
+            loop.call_soon_threadsafe(_fut_set_exc, fut, err)
+
+    async def close(self):
+        self._close_both()
+
+    def _close_both(self):
+        for end in (self, self.peer):
+            if end is None or end.closed:
+                continue
+            end.closed = True
+            if end.on_close is not None:
+                end.loop.call_soon_threadsafe(_safe_on_close, end)
+
+
+def _fut_set_result(fut, value):
+    if not fut.done():
+        fut.set_result(value)
+
+
+def _fut_set_exc(fut, err):
+    if not fut.done():
+        fut.set_exception(err)
+
+
+def _safe_on_close(end):
+    try:
+        end.on_close(end)
+    except Exception:
+        traceback.print_exc()
 
 
 async def connect(
@@ -310,12 +492,23 @@ async def connect(
     on_close=None,
     timeout: float = 30.0,
 ) -> Connection:
+    server = _LOCAL_SERVERS.get(port) if host in ("127.0.0.1", "localhost") else None
+    if server is not None and server.loop is not None:
+        client = LocalConnection(asyncio.get_running_loop())
+        serv_end = LocalConnection(server.loop)
+        client.peer, serv_end.peer = serv_end, client
+        client.on_request, client.on_push, client.on_close = on_request, on_push, on_close
+        serv_end.on_request = server._on_request
+        serv_end.on_push = server._on_push
+        serv_end.on_close = server._conn_closed
+        server.connections.add(serv_end)
+        return client
     reader = writer = None
     if host in ("127.0.0.1", "localhost"):
         import os
 
         path = _uds_path(port)
-        if os.path.exists(path):
+        if path is not None and os.path.exists(path):
             try:
                 reader, writer = await asyncio.wait_for(
                     asyncio.open_unix_connection(path), timeout)

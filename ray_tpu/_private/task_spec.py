@@ -30,6 +30,17 @@ class SchedulingStrategy:
     pg_bundle_index: int = -1
     pg_capture_child_tasks: bool = False
 
+    # Tuple state instead of the default instance-dict pickle: strategy rides
+    # in every task frame, and field names in the stream cost real CPU on the
+    # 2-4 hops a spec makes (cf. reference: TaskSpecification is a protobuf).
+    def __getstate__(self):
+        return (self.kind, self.node_id, self.soft, self.pg_id,
+                self.pg_bundle_index, self.pg_capture_child_tasks)
+
+    def __setstate__(self, s):
+        (self.kind, self.node_id, self.soft, self.pg_id,
+         self.pg_bundle_index, self.pg_capture_child_tasks) = s
+
 
 @dataclass
 class TaskSpec:
@@ -61,6 +72,38 @@ class TaskSpec:
     get_if_exists: bool = False
     # retry bookkeeping (mutated by controller):
     attempt: int = 0
+
+    def __getstate__(self):
+        return (self.task_id, self.kind, self.name, self.function_id,
+                self.method_name, self.args, self.kwargs, self.num_returns,
+                self.resources, self.strategy, self.max_retries,
+                self.retry_exceptions, self.runtime_env, self.owner_id,
+                self.owner_addr, self.actor_id, self.max_restarts,
+                self.max_task_retries, self.max_concurrency, self.actor_name,
+                self.namespace, self.get_if_exists, self.attempt)
+
+    def __setstate__(self, s):
+        (self.task_id, self.kind, self.name, self.function_id,
+         self.method_name, self.args, self.kwargs, self.num_returns,
+         self.resources, self.strategy, self.max_retries,
+         self.retry_exceptions, self.runtime_env, self.owner_id,
+         self.owner_addr, self.actor_id, self.max_restarts,
+         self.max_task_retries, self.max_concurrency, self.actor_name,
+         self.namespace, self.get_if_exists, self.attempt) = s
+
+    def clone(self) -> "TaskSpec":
+        """Shallow copy with its own SchedulingStrategy. The controller
+        mutates specs it accepts (attempt, max_retries, pg_bundle_index);
+        over the in-process transport the submitter's live object arrives, so
+        ingestion points clone to keep owner-side state (lineage specs,
+        shared strategy objects) isolated."""
+        new = object.__new__(TaskSpec)
+        new.__setstate__(self.__getstate__())
+        s = self.strategy
+        ns = object.__new__(SchedulingStrategy)
+        ns.__setstate__(s.__getstate__())
+        new.strategy = ns
+        return new
 
     def return_object_ids(self) -> list[str]:
         from ray_tpu._private.ids import ObjectID, TaskID

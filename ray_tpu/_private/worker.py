@@ -292,7 +292,7 @@ class Worker:
             self._lineage.pop(oid, None)
             self.store.delete(oid)
         try:
-            self.io.spawn(self.controller.push("free_objects", oids=oids))
+            self.controller.push_threadsafe("free_objects", oids=oids)
         except Exception:
             pass
 
@@ -317,16 +317,16 @@ class Worker:
             parts = [sobj.to_bytes()]
             self._inline_cache[oid] = parts
             if register:
-                self.io.run(self.controller.push(
+                self.controller.push_threadsafe(
                     "register_put", oid=oid, size=size, inline=parts,
-                    holder=self.server_addr, owner=self.worker_id))
+                    holder=self.server_addr, owner=self.worker_id)
         else:
             self.store.put(oid, sobj.to_parts())
             holder = self.agent_addr or self.server_addr
             if register:
-                self.io.run(self.controller.push(
+                self.controller.push_threadsafe(
                     "register_put", oid=oid, size=size, inline=None,
-                    holder=holder, owner=self.worker_id))
+                    holder=holder, owner=self.worker_id)
         res = self._resolutions.setdefault(oid, _Resolution())
         res.resolve(None, [self.server_addr], None)
 

@@ -215,6 +215,10 @@ class WorkerProc:
                 results.append((oid, [sobj.to_bytes()], size, None))
             else:
                 self.worker.store.put(oid, sobj.to_parts())
+                # Drop the producer's mapping: the agent is the advertised
+                # holder, and keeping it would pin freed pages until this
+                # worker exits (same-host readers re-attach from the file).
+                self.worker.store.detach(oid)
                 results.append((oid, None, size, self.agent_addr))
         return results
 
