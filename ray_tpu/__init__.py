@@ -158,7 +158,7 @@ def cancel(ref: ObjectRef, *, force: bool = False):
     the worker process and get() raises WorkerCrashedError. Child tasks are
     not cancelled recursively."""
     w = _require_worker()
-    return w.io.run(w.controller.call("cancel_task", task_id=ref.task_id(), force=force))
+    return w.cancel_task(ref.task_id(), force)
 
 
 def cluster_resources() -> dict[str, float]:

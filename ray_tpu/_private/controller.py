@@ -111,6 +111,12 @@ class Controller:
         self._tasks: list[asyncio.Task] = []
         self._stopping = False
         self.port = 0
+        # Worker leases (reference NormalTaskSubmitter lease pools,
+        # normal_task_submitter.cc:296): owners lease workers by scheduling
+        # class and push tasks to them DIRECTLY; the controller only accounts
+        # resources and brokers worker acquisition. lease_id -> entry.
+        self.leases: dict[str, dict] = {}
+        self._last_need_push = 0.0
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
         self.port = await self.server.start(host, port)
@@ -153,6 +159,7 @@ class Controller:
         elif kind == "client":
             wid = conn.meta.get("worker_id")
             self.client_conns.pop(wid, None)
+            asyncio.ensure_future(self._reap_owner_leases(wid))
 
     # ------------------------------------------------------- registration
     async def _h_register(self, conn, a):
@@ -217,6 +224,8 @@ class Controller:
             self._consume(nid, spec, demand)
             asyncio.ensure_future(self._dispatch_bg(nid, spec, demand))
         self.pending.extend(still_pending)
+        if still_pending:
+            self._maybe_push_need_resources()
 
     async def _dispatch_bg(self, nid: str, spec: TaskSpec, demand: ResourceSet):
         ok = await self._dispatch(nid, spec)
@@ -486,6 +495,159 @@ class Controller:
             return None
         return force
 
+    # ------------------------------------------------------------- leases
+    async def _h_lease_workers(self, conn, a):
+        """Grant up to `count` leased workers matching a resource demand +
+        strategy. Each lease holds the demand's resources like a running
+        task; the holder streams tasks to the worker directly and returns
+        the lease when idle (reference RequestWorkerLease,
+        node_manager.proto:404, with the submitter-side lease caching of
+        normal_task_submitter.cc)."""
+        import uuid
+
+        owner = conn.meta.get("worker_id") or a.get("owner_id")
+        demand = ResourceSet(_raw=a["resources"])
+        strategy = a["strategy"]
+        if isinstance(conn, rpc.LocalConnection):
+            s = strategy
+            strategy = type(s)()
+            strategy.__setstate__(s.__getstate__())
+        granted = []
+        for _ in range(max(1, min(int(a.get("count", 1)), 64))):
+            nid = pick_node(demand, strategy, self.nodes, self.pg_bundles)
+            if nid is None:
+                break
+            nconn = self.node_conns.get(nid)
+            if nconn is None or nconn.closed:
+                break
+            self._consume_for(nid, strategy, demand)
+            try:
+                # Margin over the agent's own acquire timeout: if the agent
+                # raises first we get a clean error reply; timing out here
+                # first would strand a slot in 'leased' with no lease entry.
+                rep = await nconn.call(
+                    "lease_worker", _timeout=CONFIG.worker_register_timeout_s + 5)
+            except Exception:
+                self._release_for(nid, strategy, demand)
+                break
+            lease_id = uuid.uuid4().hex[:16]
+            self.leases[lease_id] = {
+                "owner": owner,
+                "node_id": nid,
+                "worker_id": rep["worker_id"],
+                "demand": demand.raw(),
+                "strategy": strategy,
+            }
+            granted.append({
+                "lease_id": lease_id,
+                "node_id": nid,
+                "worker_id": rep["worker_id"],
+                "address": tuple(rep["address"]),
+            })
+        return {"leases": granted}
+
+    def _consume_for(self, nid: str, strategy, demand: ResourceSet):
+        if strategy.kind == "PLACEMENT_GROUP":
+            for (pgid, idx), b in self.pg_bundles.items():
+                if pgid == strategy.pg_id and b["node"] == nid and b["available"].fits(demand):
+                    if strategy.pg_bundle_index in (-1, idx):
+                        b["available"].subtract(demand)
+                        strategy.pg_bundle_index = idx
+                        return
+        self.nodes[nid].available.subtract(demand)
+
+    def _release_for(self, nid: str, strategy, demand: ResourceSet):
+        if strategy.kind == "PLACEMENT_GROUP":
+            b = self.pg_bundles.get((strategy.pg_id, strategy.pg_bundle_index))
+            if b is not None:
+                b["available"].add(demand)
+                return
+        node = self.nodes.get(nid)
+        if node is not None and node.alive:
+            node.available.add(demand)
+
+    def _drop_lease(self, lease_id: str, release: bool = True):
+        ent = self.leases.pop(lease_id, None)
+        if ent is None:
+            return None
+        if release:
+            self._release_for(ent["node_id"], ent["strategy"], ResourceSet(_raw=ent["demand"]))
+            self._kick()
+        return ent
+
+    async def _h_return_leases(self, conn, a):
+        for lease_id in a["lease_ids"]:
+            ent = self._drop_lease(lease_id)
+            if ent is None:
+                continue
+            nconn = self.node_conns.get(ent["node_id"])
+            if nconn is not None and not nconn.closed:
+                try:
+                    await nconn.push("unlease_worker", worker_id=ent["worker_id"])
+                except Exception:
+                    pass
+        return {}
+
+    async def _h_kill_leased_worker(self, conn, a):
+        """Force-cancel support for the direct task path: kill the worker
+        process behind a lease (the holder fails its in-flight tasks when the
+        direct connection drops). The lease is dropped HERE: the agent's
+        kill_worker marks the slot dead before exit, so no worker_died report
+        will follow to release the resources."""
+        for lease_id, ent in list(self.leases.items()):
+            if ent["worker_id"] == a["worker_id"]:
+                self._drop_lease(lease_id)
+                nconn = self.node_conns.get(ent["node_id"])
+                if nconn is not None and not nconn.closed:
+                    try:
+                        await nconn.push("kill_worker", worker_id=ent["worker_id"])
+                    except Exception:
+                        pass
+                return {"killed": True}
+        return {"killed": False}
+
+    async def _reap_owner_leases(self, owner: str):
+        """A lease holder disconnected: give its workers back to the pools."""
+        for lease_id, ent in list(self.leases.items()):
+            if ent["owner"] != owner:
+                continue
+            self._drop_lease(lease_id)
+            nconn = self.node_conns.get(ent["node_id"])
+            if nconn is not None and not nconn.closed:
+                try:
+                    await nconn.push("unlease_worker", worker_id=ent["worker_id"])
+                except Exception:
+                    pass
+
+    async def _lease_worker_died(self, worker_id: str):
+        for lease_id, ent in list(self.leases.items()):
+            if ent["worker_id"] == worker_id:
+                self._drop_lease(lease_id)
+                oconn = self.client_conns.get(ent["owner"])
+                if oconn is not None and not oconn.closed:
+                    try:
+                        await oconn.push("lease_invalid", lease_id=lease_id)
+                    except Exception:
+                        pass
+
+    def _maybe_push_need_resources(self):
+        """Demand exists that can't place while clients hold leases: ask them
+        to give idle ones back (rate-limited)."""
+        if not self.leases:
+            return
+        now = time.monotonic()
+        if now - self._last_need_push < 0.1:
+            return
+        self._last_need_push = now
+        owners = {ent["owner"] for ent in self.leases.values()}
+        for owner in owners:
+            oconn = self.client_conns.get(owner)
+            if oconn is not None and not oconn.closed:
+                try:
+                    oconn.push_threadsafe("need_resources")
+                except Exception:
+                    pass
+
     # ------------------------------------------------------------- objects
     async def _h_register_put(self, conn, a):
         ent = self.objects.setdefault(a["oid"], _ObjectEntry())
@@ -505,6 +667,12 @@ class Controller:
         """Push variant (no ack) — used by actor workers to advertise call
         results without adding a round trip to the direct-call fast path."""
         await self._h_register_put(conn, a)
+
+    async def _p_register_puts(self, conn, a):
+        """Batched advertise: one frame per flush of a worker's direct-path
+        result flusher."""
+        for item in a["items"]:
+            await self._h_register_put(conn, item)
 
     async def _p_add_location(self, conn, a):
         ent = self.objects.get(a["oid"])
@@ -634,6 +802,7 @@ class Controller:
             "state": ent.state,
             "address": ent.address,
             "instance": ent.instance,
+            "worker_id": ent.worker_id,
             "death_cause": ent.death_cause,
             "max_task_retries": ent.spec.max_task_retries,
         }
@@ -702,6 +871,8 @@ class Controller:
 
     async def _p_worker_died(self, conn, a):
         """Node agent reports a worker process exit."""
+        if a.get("worker_id"):
+            await self._lease_worker_died(a["worker_id"])
         actor_id = a.get("actor_id")
         task_id = a.get("task_id")
         if actor_id:
@@ -725,6 +896,16 @@ class Controller:
         node.alive = False
         self.node_conns.pop(nid, None)
         logger.warning("node %s died", nid[:8])
+        # Invalidate leases whose worker lived there.
+        for lease_id, ent in list(self.leases.items()):
+            if ent["node_id"] == nid:
+                self._drop_lease(lease_id)  # node dead: release is a no-op
+                oconn = self.client_conns.get(ent["owner"])
+                if oconn is not None and not oconn.closed:
+                    try:
+                        await oconn.push("lease_invalid", lease_id=lease_id)
+                    except Exception:
+                        pass
         # Retry tasks that were running there.
         for task_id, info in list(self.dispatched.items()):
             if info["node_id"] == nid:

@@ -1,0 +1,398 @@
+"""Owner-side worker leases: the direct task submission path.
+
+Parity target: the reference NormalTaskSubmitter + lease pools
+(core_worker/transport/normal_task_submitter.h:79 — RequestWorkerLease at
+normal_task_submitter.cc:296, direct worker-to-worker PushNormalTask at
+:186, lease reuse keyed by SchedulingKey). The owner leases workers from the
+controller once per scheduling class, then streams task specs DIRECTLY to
+the leased workers over coalescing connections; results come back on the
+same connection. The controller is out of the per-task hot path entirely —
+it only accounts lease resources and brokers worker acquisition.
+
+Failure model (owner-based, like the reference TaskManager): a dead leased
+worker fails its in-flight specs back into the class queue (attempt++ up to
+max_retries), a `lease_invalid` push from the controller does the same, and
+`need_resources` returns idle leases so other demand can place.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from ray_tpu._private import rpc
+from ray_tpu._private.serialization import dumps_oob
+from ray_tpu._private.task_spec import TaskSpec
+
+logger = logging.getLogger(__name__)
+
+# In-flight pipeline depth per leased worker. Tasks beyond the depth wait in
+# the class queue; the worker executes its pipeline serially in order.
+DEPTH = 8
+MAX_LEASES_PER_CLASS = 16
+IDLE_RETURN_S = 0.5
+REQUEST_RETRY_S = 0.1
+
+
+def _class_key(spec: TaskSpec) -> tuple:
+    s = spec.strategy
+    return (tuple(sorted(spec.resources.items())), s.kind, s.node_id, s.soft,
+            s.pg_id, s.pg_bundle_index)
+
+
+class _Lease:
+    __slots__ = ("lease_id", "worker_id", "node_id", "addr", "conn", "inflight",
+                 "buf", "flushing", "dead", "idle_since", "cls")
+
+    def __init__(self, cls, lease_id: str, worker_id: str, node_id: str, addr: tuple):
+        self.cls = cls
+        self.lease_id = lease_id
+        self.worker_id = worker_id
+        self.node_id = node_id
+        self.addr = addr
+        self.conn: Optional[rpc.Connection] = None
+        self.inflight: dict[str, TaskSpec] = {}
+        self.buf: list[TaskSpec] = []
+        self.flushing = False
+        self.dead = False
+        self.idle_since = time.monotonic()
+
+
+class _Class:
+    __slots__ = ("key", "resources", "strategy", "queue", "leases", "requesting",
+                 "depth")
+
+    def __init__(self, key: tuple, spec: TaskSpec):
+        self.key = key
+        self.resources = dict(spec.resources)
+        self.strategy = spec.strategy
+        self.queue: deque[TaskSpec] = deque()
+        self.leases: dict[str, _Lease] = {}
+        self.requesting = False
+        # SPREAD must place per task across nodes (reference spread policy),
+        # so no pipelining: each task forces its own lease while the queue
+        # is non-empty.
+        self.depth = 1 if spec.strategy.kind == "SPREAD" else DEPTH
+
+
+class LeaseManager:
+    """One per Worker process (drivers and executing workers alike)."""
+
+    def __init__(self, worker):
+        self.w = worker  # ray_tpu._private.worker.Worker
+        self.classes: dict[tuple, _Class] = {}
+        self._by_conn: dict = {}  # conn -> _Lease
+        self._by_id: dict[str, _Lease] = {}
+        self._lock = threading.Lock()
+        self._pump_scheduled = False
+        self._cancelled: dict[str, bool] = {}  # task_id -> force
+        self._idle_task = None
+        self._shutdown = False
+
+    # ------------------------------------------------------------- submit
+    def submit(self, spec: TaskSpec):
+        """Called from any thread. Refs/resolutions already registered by
+        Worker.submit_task."""
+        key = _class_key(spec)
+        with self._lock:
+            cls = self.classes.get(key)
+            if cls is None:
+                cls = self.classes[key] = _Class(key, spec)
+            cls.queue.append(spec)
+            need = not self._pump_scheduled
+            self._pump_scheduled = True
+        if need:
+            self.w.io.spawn(self._a_pump_all())
+
+    # All methods below run on the worker's IO loop.
+    async def _a_pump_all(self):
+        with self._lock:
+            self._pump_scheduled = False
+        for cls in list(self.classes.values()):
+            self._pump(cls)
+        if self._idle_task is None and not self._shutdown:
+            self._idle_task = asyncio.ensure_future(self._a_idle_loop())
+
+    def _pump(self, cls: _Class):
+        # Assign queued specs to the least-loaded live leases.
+        live = [l for l in cls.leases.values() if not l.dead]
+        while cls.queue and live:
+            lease = min(live, key=lambda l: len(l.inflight))
+            if len(lease.inflight) >= cls.depth:
+                break
+            with self._lock:
+                if not cls.queue:
+                    break
+                spec = cls.queue.popleft()
+            if self._consume_cancel_queued(spec):
+                continue
+            lease.inflight[spec.task_id] = spec
+            lease.buf.append(spec)
+            if not lease.flushing:
+                lease.flushing = True
+                asyncio.ensure_future(self._a_flush(lease))
+        if cls.queue and not cls.requesting:
+            outstanding = len(cls.queue) + sum(len(l.inflight) for l in live)
+            want = min(MAX_LEASES_PER_CLASS, outstanding)
+            need = want - len(cls.leases)
+            if need > 0:
+                cls.requesting = True
+                asyncio.ensure_future(self._a_request(cls, need))
+
+    def _consume_cancel_queued(self, spec: TaskSpec) -> bool:
+        force = self._cancelled.pop(spec.task_id, None)
+        if force is None:
+            return False
+        self._fail_spec(spec, {"type": "TaskCancelledError",
+                               "message": f"task {spec.name} cancelled"})
+        return True
+
+    async def _a_request(self, cls: _Class, count: int):
+        try:
+            rep = await self.w.controller.call(
+                "lease_workers", resources=cls.resources, strategy=cls.strategy,
+                count=count, owner_id=self.w.worker_id)
+        except Exception:
+            rep = {"leases": []}
+        finally:
+            cls.requesting = False
+        for g in rep["leases"]:
+            lease = _Lease(cls, g["lease_id"], g["worker_id"], g["node_id"],
+                           tuple(g["address"]))
+            cls.leases[lease.lease_id] = lease
+            self._by_id[lease.lease_id] = lease
+            asyncio.ensure_future(self._a_connect(lease))
+        if not rep["leases"] and cls.queue and not any(
+                not l.dead for l in cls.leases.values()):
+            # Nothing placeable right now: poll until resources free up
+            # (node death recovery, infeasible-demand waiting).
+            await asyncio.sleep(REQUEST_RETRY_S)
+            if not self._shutdown:
+                self._pump(cls)
+
+    async def _a_connect(self, lease: _Lease):
+        try:
+            conn = await rpc.connect(
+                *lease.addr, on_push=self._on_worker_push,
+                on_close=self._on_worker_conn_close, timeout=10)
+            rep = await conn.call("whoami", _timeout=10)
+            if rep.get("worker_id") != lease.worker_id:
+                await conn.close()
+                raise ConnectionError("stale lease address (port reused)")
+        except Exception as e:
+            logger.warning("lease %s connect failed: %s", lease.lease_id[:8], e)
+            self._lease_failed(lease, release=True)
+            return
+        lease.conn = conn
+        self._by_conn[conn] = lease
+        if lease.dead:  # invalidated while connecting
+            await conn.close()
+            return
+        self._pump(lease.cls)
+        if lease.buf and not lease.flushing:
+            lease.flushing = True
+            asyncio.ensure_future(self._a_flush(lease))
+
+    async def _a_flush(self, lease: _Lease):
+        while True:
+            if lease.conn is None:
+                lease.flushing = False
+                return  # _a_connect flushes once connected
+            batch = lease.buf
+            lease.buf = []
+            if not batch:
+                lease.flushing = False
+                return
+            try:
+                await lease.conn.push("exec_tasks", specs=batch)
+            except Exception:
+                lease.flushing = False
+                self._lease_failed(lease, release=False)
+                return
+
+    # ----------------------------------------------------------- results
+    async def _on_worker_push(self, conn, method, a):
+        lease = self._by_conn.get(conn)
+        if lease is None:
+            return
+        if method == "tasks_done":
+            for item in a["done"]:
+                self._task_done(lease, item)
+            lease.idle_since = time.monotonic()
+            self._pump(lease.cls)
+
+    def _task_done(self, lease: _Lease, item: dict):
+        spec = lease.inflight.pop(item["task_id"], None)
+        if spec is None:
+            self._cancelled.pop(item["task_id"], None)
+            return
+        self._cancelled.pop(spec.task_id, None)
+        error = item.get("error")
+        if (error is not None and item.get("retryable")
+                and spec.attempt < spec.max_retries):
+            spec.attempt += 1
+            with self._lock:
+                lease.cls.queue.appendleft(spec)
+            return
+        for oid, inline, size, holder in item.get("results", []):
+            res = self.w._resolutions.get(oid)
+            if res is not None:
+                res.resolve(inline, [tuple(holder)] if holder else [], error)
+
+    def _fail_spec(self, spec: TaskSpec, blob: dict):
+        h, bufs = dumps_oob(blob)
+        err = [h, *bufs]
+        for oid in spec.return_object_ids():
+            res = self.w._resolutions.get(oid)
+            if res is not None:
+                res.resolve(None, [], err)
+
+    # ----------------------------------------------------------- failure
+    def _on_worker_conn_close(self, conn):
+        lease = self._by_conn.pop(conn, None)
+        if lease is not None and not self._shutdown:
+            self._lease_failed(lease, release=False)
+
+    def _lease_failed(self, lease: _Lease, release: bool):
+        """Worker/connection died. Retry its in-flight specs (attempt++) or
+        fail them; drop the lease. The controller learns of worker death from
+        the node agent and releases resources; `release` covers the
+        connect-failed case where no such signal will come."""
+        if lease.dead:
+            return
+        lease.dead = True
+        lease.cls.leases.pop(lease.lease_id, None)
+        self._by_id.pop(lease.lease_id, None)
+        if lease.conn is not None:
+            self._by_conn.pop(lease.conn, None)
+        requeue = []
+        for spec in lease.inflight.values():
+            force = self._cancelled.pop(spec.task_id, None)
+            if force is not None:
+                self._fail_spec(spec, {
+                    "type": "WorkerCrashedError" if force else "TaskCancelledError",
+                    "message": f"task {spec.name} cancelled"})
+            elif spec.attempt < spec.max_retries:
+                spec.attempt += 1
+                requeue.append(spec)
+            else:
+                self._fail_spec(spec, {
+                    "type": "WorkerCrashedError",
+                    "message": f"leased worker {lease.worker_id[:8]} died"})
+        lease.inflight.clear()
+        if requeue:
+            with self._lock:
+                for spec in reversed(requeue):
+                    lease.cls.queue.appendleft(spec)
+        if release:
+            asyncio.ensure_future(self._a_return([lease.lease_id]))
+        if lease.cls.queue:
+            self._pump(lease.cls)
+
+    def on_lease_invalid(self, lease_id: str):
+        lease = self._by_id.get(lease_id)
+        if lease is not None:
+            self._lease_failed(lease, release=False)
+
+    # -------------------------------------------------------- cancellation
+    def cancel(self, task_id: str, force: bool) -> bool:
+        """True if the task is managed here (queued or in flight)."""
+        with self._lock:
+            for cls in self.classes.values():
+                for spec in cls.queue:
+                    if spec.task_id == task_id:
+                        cls.queue.remove(spec)
+                        self._fail_spec(spec, {
+                            "type": "TaskCancelledError",
+                            "message": f"task {spec.name} cancelled"})
+                        return True
+        for lease in list(self._by_id.values()):
+            spec = lease.inflight.get(task_id)
+            if spec is None:
+                continue
+            self._cancelled[task_id] = force
+            spec.max_retries = 0  # never retry a cancelled task
+            if force:
+                self.w.io.spawn(self.w.controller.call(
+                    "kill_leased_worker", worker_id=lease.worker_id))
+            else:
+                # Resolve the caller NOW (the spec may be queued behind a
+                # long task in the worker's pipeline — reference cancels
+                # pre-dispatch tasks immediately); the worker-side interrupt
+                # or skip still runs, and a value that races in later is a
+                # benign overwrite.
+                if lease.conn is not None:
+                    self.w.io.spawn(lease.conn.push("cancel", task_id=task_id))
+                self._fail_spec(spec, {"type": "TaskCancelledError",
+                                       "message": f"task {spec.name} cancelled"})
+            return True
+        return False
+
+    # ------------------------------------------------------ lease returns
+    async def _a_idle_loop(self):
+        while not self._shutdown:
+            await asyncio.sleep(0.25)
+            now = time.monotonic()
+            to_return = []
+            for cls in self.classes.values():
+                if cls.queue:
+                    continue
+                for lease in list(cls.leases.values()):
+                    if (not lease.dead and not lease.inflight and not lease.buf
+                            and now - lease.idle_since > IDLE_RETURN_S):
+                        lease.dead = True
+                        cls.leases.pop(lease.lease_id, None)
+                        self._by_id.pop(lease.lease_id, None)
+                        to_return.append(lease)
+            if to_return:
+                for lease in to_return:
+                    if lease.conn is not None:
+                        self._by_conn.pop(lease.conn, None)
+                        try:
+                            await lease.conn.close()
+                        except Exception:
+                            pass
+                await self._a_return([l.lease_id for l in to_return])
+
+    def on_need_resources(self):
+        """Controller has demand it can't place: return idle leases now."""
+        self.w.io.spawn(self._a_return_idle())
+
+    async def _a_return_idle(self):
+        to_return = []
+        for cls in self.classes.values():
+            if cls.queue:
+                continue
+            for lease in list(cls.leases.values()):
+                if not lease.dead and not lease.inflight and not lease.buf:
+                    lease.dead = True
+                    cls.leases.pop(lease.lease_id, None)
+                    self._by_id.pop(lease.lease_id, None)
+                    if lease.conn is not None:
+                        self._by_conn.pop(lease.conn, None)
+                        try:
+                            await lease.conn.close()
+                        except Exception:
+                            pass
+                    to_return.append(lease.lease_id)
+        if to_return:
+            await self._a_return(to_return)
+
+    async def _a_return(self, lease_ids: list[str]):
+        try:
+            await self.w.controller.call("return_leases", lease_ids=lease_ids)
+        except Exception:
+            pass
+
+    def shutdown(self):
+        self._shutdown = True
+        ids = list(self._by_id)
+        if ids:
+            try:
+                self.w.io.run(self._a_return(ids), timeout=2)
+            except Exception:
+                pass

@@ -110,6 +110,10 @@ class NodeAgent:
     async def _on_ctrl_request(self, conn, method, a):
         if method == "dispatch":
             return await self._dispatch(a["spec"])
+        if method == "lease_worker":
+            slot = await self._acquire_pool_worker()
+            slot.state = "leased"
+            return {"worker_id": slot.worker_id, "address": slot.address}
         raise rpc.RpcError(f"agent: unknown ctrl method {method}")
 
     async def _on_ctrl_push(self, conn, method, a):
@@ -120,6 +124,10 @@ class NodeAgent:
             slot = self.workers.get(a["worker_id"])
             if slot is not None:
                 self._kill_slot(slot)
+        elif method == "unlease_worker":
+            slot = self.workers.get(a["worker_id"])
+            if slot is not None and slot.state == "leased":
+                self._worker_became_idle(slot)
         elif method == "cancel_task":
             slot = self.workers.get(a["worker_id"])
             if slot is None or slot.task_id != a["task_id"]:
@@ -212,6 +220,9 @@ class NodeAgent:
                                       needs_tpu=self._needs_tpu(spec))
             await asyncio.wait_for(slot.registered.wait(), CONFIG.worker_register_timeout_s)
             return slot
+        return await self._acquire_pool_worker()
+
+    async def _acquire_pool_worker(self) -> _WorkerSlot:
         while True:
             for slot in self.workers.values():
                 if slot.state == "idle":
@@ -223,7 +234,7 @@ class NodeAgent:
                 if not s.dedicated and s.state in ("starting", "reserved", "busy", "idle")
             )
             if pool_active < self._pool_cap():
-                self._spawn_worker(spec.runtime_env)
+                self._spawn_worker()
             fut = asyncio.get_running_loop().create_future()
             self._idle_waiters.append(fut)
             await asyncio.wait_for(fut, CONFIG.worker_register_timeout_s)
@@ -315,7 +326,7 @@ class NodeAgent:
         prev_state = slot.state
         slot.state = "dead"
         self.workers.pop(slot.worker_id, None)
-        if prev_state in ("busy", "actor") or slot.actor_id:
+        if prev_state in ("busy", "actor", "leased") or slot.actor_id:
             try:
                 await self.controller.push(
                     "worker_died",

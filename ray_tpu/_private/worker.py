@@ -21,6 +21,7 @@ import logging
 import threading
 import time
 import traceback
+from collections import deque
 from typing import Any, Optional
 
 from ray_tpu import exceptions as exc
@@ -175,18 +176,22 @@ class Worker:
         # fn -> fid, weakly keyed so dynamically created functions (and any
         # closure state they capture) stay collectible.
         self._fn_id_cache: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
-        # Direct actor transport:
-        self._actor_conns: dict[str, rpc.Connection] = {}
+        # Direct actor transport: one ordered, pipelined, frame-coalescing
+        # pipe per callee actor (reference ActorTaskSubmitter +
+        # sequential_actor_submit_queue.h).
+        self._actor_pipes: dict[str, "_ActorPipe"] = {}
         self._actor_info: dict[str, dict] = {}
-        # Per-actor asyncio locks serializing connect+write so calls arrive
-        # in submission order while replies overlap (reference
-        # sequential_actor_submit_queue.h — per-caller ordering guarantee).
-        self._actor_send_locks: dict[str, asyncio.Lock] = {}
         self._submit_lock = threading.Lock()
         self._submit_buf: list = []
         self._submit_flushing = False
         # Hook used by worker_proc to execute actor calls in-order:
-        self.actor_call_handler = None  # async def (spec) -> reply dict
+        self.actor_push_handler = None  # def (conn, spec)
+        # Hooks used by worker_proc for the direct (leased) task path:
+        self.task_push_handler = None  # def (conn, spec) — enqueue for exec
+        self.task_cancel_handler = None  # def (task_id)
+        from ray_tpu._private.lease import LeaseManager
+
+        self.lease_mgr = LeaseManager(self)
         self._shutdown = False
 
     # ------------------------------------------------------------ lifecycle
@@ -208,13 +213,18 @@ class Worker:
 
     def disconnect(self):
         self._shutdown = True
+        try:
+            self.lease_mgr.shutdown()
+        except Exception:
+            pass
 
         async def _bye():
             await self.server.stop()
             if self.controller is not None:
                 await self.controller.close()
-            for c in self._actor_conns.values():
-                await c.close()
+            for pipe in self._actor_pipes.values():
+                if pipe.conn is not None:
+                    await pipe.conn.close()
 
         try:
             self.io.run(_bye(), timeout=5)
@@ -241,19 +251,37 @@ class Worker:
             if parts is not None:
                 return {"found": True, "data": b"".join(bytes(p) for p in parts)}
             return {"found": False}
-        if method == "actor_call":
-            if self.actor_call_handler is None:
-                raise rpc.RpcError("not an actor worker")
-            return await self.actor_call_handler(a["spec"])
         if method == "health":
             return {"ok": True}
+        if method == "whoami":
+            # Peer-identity handshake: (host, port) is ambiguous across
+            # worker generations (a new worker can reuse a dead worker's
+            # ephemeral port), so direct-connection holders verify the
+            # worker id before trusting the link.
+            return {"worker_id": self.worker_id}
         raise rpc.RpcError(f"worker: unknown method {method}")
 
     async def _on_push(self, conn, method, a):
-        pass
+        # Direct (leased) task path: owners stream specs straight to this
+        # worker's server (reference PushNormalTask, core_worker.proto:462).
+        if method == "exec_tasks":
+            if self.task_push_handler is not None:
+                for spec in a["specs"]:
+                    self.task_push_handler(conn, spec)
+        elif method == "actor_tasks":
+            if self.actor_push_handler is not None:
+                for spec in a["specs"]:
+                    self.actor_push_handler(conn, spec)
+        elif method == "cancel":
+            if self.task_cancel_handler is not None:
+                self.task_cancel_handler(a["task_id"])
 
     async def _on_ctrl_push(self, conn, method, a):
-        if method == "object_ready":
+        if method == "lease_invalid":
+            self.lease_mgr.on_lease_invalid(a["lease_id"])
+        elif method == "need_resources":
+            self.lease_mgr.on_need_resources()
+        elif method == "object_ready":
             res = self._resolutions.setdefault(a["oid"], _Resolution())
             res.resolve(a.get("inline"), [tuple(h) for h in a.get("holders", [])], a.get("error"))
         elif method == "object_lost":
@@ -622,6 +650,13 @@ class Worker:
             if spec.max_retries != 0:
                 self._lineage[oid] = spec
             refs.append(ObjectRef(oid, owned=True, worker=self))
+        # Direct path: lease workers by scheduling class and stream specs to
+        # them (reference NormalTaskSubmitter lease pools). TPU tasks keep
+        # the controller-dispatch path — they need a dedicated worker whose
+        # chip lease dies with the process.
+        if not any(k.startswith("TPU") for k in spec.resources):
+            self.lease_mgr.submit(spec)
+            return refs
         # Coalesced one-way submit: bursts of .remote() calls ride one RPC
         # frame (reference batches task submission through the Cython layer;
         # here the flusher drains whatever accumulated while the previous
@@ -633,6 +668,14 @@ class Worker:
         if need_flush:
             self.io.spawn(self._a_flush_submits())
         return refs
+
+    def cancel_task(self, task_id: str, force: bool):
+        """Cancel a task wherever it lives: the owner's lease pipelines (the
+        direct path) or the controller queue (TPU/legacy/reconstruction)."""
+        if self.lease_mgr.cancel(task_id, force):
+            return {"status": "cancelled_direct"}
+        return self.io.run(self.controller.call(
+            "cancel_task", task_id=task_id, force=force))
 
     async def _a_flush_submits(self):
         while True:
@@ -646,8 +689,12 @@ class Worker:
                 await self.controller.push("submit_batch", specs=batch)
             except Exception as e:
                 # The push failed after the specs left the buffer: fail the
-                # batch's refs so callers see an error instead of a hang.
+                # batch's refs so callers see an error instead of a hang —
+                # including anything that accumulated while the push was in
+                # flight (no new flusher was spawned for those specs).
                 with self._submit_lock:
+                    batch.extend(self._submit_buf)
+                    self._submit_buf.clear()
                     self._submit_flushing = False
                 h, bufs = dumps_oob({"type": "WorkerCrashedError",
                                      "message": f"task submission failed: {e}"})
@@ -707,16 +754,6 @@ class Worker:
         self._actor_info[actor_id] = rep
         return rep
 
-    async def _a_actor_conn(self, actor_id: str) -> rpc.Connection:
-        conn = self._actor_conns.get(actor_id)
-        if conn is not None and not conn.closed:
-            return conn
-        info = await self._a_resolve_actor(actor_id)
-        if info.get("address") is None:
-            raise exc.ActorUnavailableError(f"actor {actor_id[:12]} has no address")
-        conn = await rpc.connect(*info["address"], timeout=10)
-        self._actor_conns[actor_id] = conn
-        return conn
 
     def submit_actor_task(self, actor_id: str, method_name: str, args, kwargs, *,
                           num_returns=1, name=None, max_task_retries=0) -> list[ObjectRef]:
@@ -739,66 +776,21 @@ class Worker:
         for oid in spec.return_object_ids():
             self._resolutions[oid] = _Resolution()
             refs.append(ObjectRef(oid, owned=True, worker=self))
-        # call_soon_threadsafe is FIFO per thread and the per-actor send lock
-        # is FIFO, so spawning under the submit lock fixes the arrival order.
-        with self._submit_lock:
-            self.io.spawn(self._a_send_actor_call(actor_id, spec, max(0, max_task_retries)))
+        pipe = self._actor_pipes.get(actor_id)
+        if pipe is None:
+            with self._submit_lock:
+                pipe = self._actor_pipes.get(actor_id)
+                if pipe is None:
+                    pipe = self._actor_pipes[actor_id] = _ActorPipe(self, actor_id)
+        pipe.submit(spec, max(0, max_task_retries))
         return refs
 
-    async def _a_send_actor_call(self, actor_id: str, spec: TaskSpec, retries_left: int):
-        """Direct actor call with transparent retry across actor restarts
-        (reference ActorTaskSubmitter: queued calls resubmitted on restart
-        when max_task_retries allows).
-
-        Ordering: the per-actor send lock is held from connection resolution
-        until the request bytes are written, so requests from this caller
-        arrive at the actor in submission order; replies are awaited outside
-        the lock so many calls stay in flight (pipelined)."""
-        lock = self._actor_send_locks.setdefault(actor_id, asyncio.Lock())
-        connect_attempts = 0
-        while True:
-            async with lock:
-                try:
-                    conn = await self._a_actor_conn(actor_id)
-                except (exc.ActorError, exc.TaskError) as e:
-                    self._fail_actor_call(spec, e)
-                    return
-                except Exception as e:
-                    # Stale address or refused connection: re-resolve a few
-                    # times (the actor may be mid-restart, not re-registered).
-                    self._actor_conns.pop(actor_id, None)
-                    self._actor_info.pop(actor_id, None)
-                    connect_attempts += 1
-                    if connect_attempts <= 20:
-                        await asyncio.sleep(0.1)
-                        continue
-                    self._fail_actor_call(spec, e)
-                    return
-                try:
-                    fut = await conn.call_start("actor_call", spec=spec)
-                except Exception:
-                    self._actor_conns.pop(actor_id, None)
-                    fut = None
-            if fut is not None:
-                try:
-                    rep = await fut
-                    self._apply_actor_reply(spec, rep)
-                    return
-                except Exception:
-                    pass
-            # The connection died mid-call: retry across restart if allowed.
-            self._actor_conns.pop(actor_id, None)
-            self._actor_info.pop(actor_id, None)
-            if retries_left > 0:
-                retries_left -= 1
-                await asyncio.sleep(CONFIG.task_retry_delay_s)
-                continue
-            self._fail_actor_call(
-                spec, exc.ActorDiedError(f"actor {actor_id[:12]} died mid-call"))
-            return
-
     def _fail_actor_call(self, spec: TaskSpec, e: Exception):
-        h, bufs = dumps_oob({"type": "ActorDiedError", "message": str(e)})
+        blob = {"type": "ActorDiedError", "message": str(e)}
+        if isinstance(e, exc.TaskError):
+            blob = {"type": "TaskError", "function_name": spec.name,
+                    "traceback": str(e), "cause": None}
+        h, bufs = dumps_oob(blob)
         for oid in spec.return_object_ids():
             res = self._resolutions.setdefault(oid, _Resolution())
             res.resolve(None, [], [h, *bufs])
@@ -817,7 +809,6 @@ class Worker:
 
     def kill_actor(self, actor_id: str, no_restart=True):
         self.io.run(self.controller.call("kill_actor", actor_id=actor_id, no_restart=no_restart))
-        self._actor_conns.pop(actor_id, None)
         self._actor_info.pop(actor_id, None)
 
     # ------------------------------------------------------------- cluster
@@ -829,3 +820,140 @@ class Worker:
 
     def kv(self, op: str, **kw):
         return self.io.run(self.controller.call(f"kv_{op}", **kw))
+
+
+class _ActorPipe:
+    """Ordered, pipelined transport to one actor.
+
+    Bursts of calls ride coalesced `actor_tasks` frames; replies come back
+    as batched `tasks_done` pushes keyed by task_id (so out-of-order
+    completion from async/threaded actors resolves correctly). On connection
+    loss, in-flight calls with retries left are resubmitted IN ORDER across
+    the actor restart; the rest fail with ActorDiedError (reference
+    ActorTaskSubmitter restart semantics)."""
+
+    __slots__ = ("w", "actor_id", "lock", "queue", "inflight", "seq", "conn",
+                 "pumping")
+
+    def __init__(self, worker: "Worker", actor_id: str):
+        self.w = worker
+        self.actor_id = actor_id
+        self.lock = threading.Lock()
+        self.queue: deque = deque()
+        self.inflight: dict[str, tuple] = {}  # task_id -> (spec, retries, seq)
+        self.seq = 0
+        self.conn = None
+        self.pumping = False
+
+    def submit(self, spec: TaskSpec, retries: int):
+        with self.lock:
+            self.seq += 1
+            self.queue.append((spec, retries, self.seq))
+            need = not self.pumping
+            self.pumping = True
+        if need:
+            self.w.io.spawn(self._a_pump())
+
+    async def _a_pump(self):
+        while True:
+            if self.conn is None or self.conn.closed:
+                if not await self._a_connect():
+                    return  # everything failed; pumping reset by _a_connect
+            with self.lock:
+                batch = list(self.queue)
+                self.queue.clear()
+                if not batch:
+                    self.pumping = False
+                    return
+            for spec, retries, seq in batch:
+                self.inflight[spec.task_id] = (spec, retries, seq)
+            try:
+                await self.conn.push("actor_tasks", specs=[b[0] for b in batch])
+            except Exception:
+                pass  # close handler redistributes inflight; loop reconnects
+
+    async def _a_connect(self) -> bool:
+        attempts = 0
+        while True:
+            try:
+                info = await self.w._a_resolve_actor(self.actor_id)
+                if info.get("address") is None:
+                    raise exc.ActorUnavailableError(
+                        f"actor {self.actor_id[:12]} has no address")
+                conn = await rpc.connect(
+                    *info["address"], on_push=self._on_push,
+                    on_close=self._on_close, timeout=10)
+                # A new worker may have reused a dead worker's port while the
+                # controller still reports the old instance ALIVE: verify
+                # identity before trusting the link.
+                expect = info.get("worker_id")
+                if expect is not None:
+                    rep = await conn.call("whoami", _timeout=10)
+                    if rep.get("worker_id") != expect:
+                        await conn.close()
+                        raise ConnectionError("stale actor address (port reused)")
+                self.conn = conn
+                return True
+            except (exc.ActorError, exc.TaskError) as e:
+                self._fail_all(e)
+                return False
+            except Exception as e:
+                # Stale address / refused connection: the actor may be
+                # mid-restart and not re-registered yet — re-resolve.
+                self.w._actor_info.pop(self.actor_id, None)
+                attempts += 1
+                if attempts > 20:
+                    self._fail_all(e, permanent=False)
+                    return False
+                await asyncio.sleep(0.1)
+
+    def _fail_all(self, e: Exception, permanent: bool = True):
+        with self.lock:
+            q = list(self.queue)
+            self.queue.clear()
+            self.pumping = False
+        inf = sorted(self.inflight.values(), key=lambda t: t[2])
+        self.inflight.clear()
+        for spec, _, _ in inf:
+            self.w._fail_actor_call(spec, e)
+        for spec, _, _ in q:
+            self.w._fail_actor_call(spec, e)
+        if permanent:
+            # Keep the pipe reusable: a later submit re-resolves the actor
+            # (named get_if_exists / restarted handles), failing fast again
+            # if it is still dead.
+            self.w._actor_info.pop(self.actor_id, None)
+
+    async def _on_push(self, conn, method, a):
+        if method != "tasks_done":
+            return
+        for item in a["done"]:
+            ent = self.inflight.pop(item["task_id"], None)
+            if ent is None:
+                continue
+            self.w._apply_actor_reply(ent[0], item)
+
+    def _on_close(self, conn):
+        if self.conn is not conn:
+            return
+        self.conn = None
+        if self.w._shutdown:
+            return
+        self.w._actor_info.pop(self.actor_id, None)
+        # Redistribute in-flight calls: retryable ones go back to the FRONT
+        # of the queue in sequence order; the rest fail now.
+        inf = sorted(self.inflight.values(), key=lambda t: t[2])
+        self.inflight.clear()
+        with self.lock:
+            for spec, retries, seq in reversed(inf):
+                if retries > 0:
+                    self.queue.appendleft((spec, retries - 1, seq))
+            need = bool(self.queue) and not self.pumping
+            if need:
+                self.pumping = True
+        for spec, retries, _ in inf:
+            if retries <= 0:
+                self.w._fail_actor_call(spec, exc.ActorDiedError(
+                    f"actor {self.actor_id[:12]} died mid-call"))
+        if need:
+            self.w.io.spawn(self._a_pump())

@@ -31,6 +31,43 @@ from ray_tpu._private.worker import ObjectRef, Worker, set_global_worker
 logger = logging.getLogger(__name__)
 
 
+class _BatchPusher:
+    """Thread-safe coalescing pusher: .add() from any thread, frames drain on
+    the connection's loop — bursts of per-task messages ride few frames
+    (mirrors the submit-side flusher in Worker._a_flush_submits)."""
+
+    def __init__(self, conn, method: str, field: str):
+        self.conn = conn
+        self.method = method
+        self.field = field
+        self._buf: list = []
+        self._lock = threading.Lock()
+        self._flushing = False
+
+    def add(self, item):
+        with self._lock:
+            self._buf.append(item)
+            if self._flushing:
+                return
+            self._flushing = True
+        asyncio.run_coroutine_threadsafe(self._a_flush(), self.conn.loop)
+
+    async def _a_flush(self):
+        while True:
+            with self._lock:
+                batch = self._buf
+                self._buf = []
+                if not batch:
+                    self._flushing = False
+                    return
+            try:
+                await self.conn.push(self.method, **{self.field: batch})
+            except Exception:
+                with self._lock:
+                    self._flushing = False
+                return  # peer gone; owner-side failure handling takes over
+
+
 class WorkerProc:
     def __init__(self):
         self.worker_id = os.environ["RT_WORKER_ID"]
@@ -58,13 +95,19 @@ class WorkerProc:
         self._exec_thread_ident: int | None = None
         self._current_task_id: str | None = None
         self._cancel_requested: set[str] = set()  # cancels that beat the task
+        self._done_pushers: dict = {}  # owner conn -> _BatchPusher
+        self._advertise_pusher: _BatchPusher | None = None
         self._running = True
 
     # ------------------------------------------------------------ startup
     def start(self):
         self.worker.connect()
         set_global_worker(self.worker)
-        self.worker.actor_call_handler = self._handle_actor_call
+        self.worker.actor_push_handler = self._on_actor_push
+        self.worker.task_push_handler = self._on_task_push
+        self.worker.task_cancel_handler = self._cancel_current
+        self._advertise_pusher = _BatchPusher(
+            self.worker.controller, "register_puts", "items")
 
         async def _join_agent():
             self.agent_conn = await rpc.connect(
@@ -87,6 +130,22 @@ class WorkerProc:
             self._running = False
             self.exec_queue.put(("exit", None, None))
 
+    def _on_task_push(self, conn, spec: TaskSpec):
+        """Direct-path spec from a lease holder (runs on the IO loop)."""
+        self.exec_queue.put(("ltask", spec, conn))
+
+    def _on_actor_push(self, conn, spec: TaskSpec):
+        """Pipelined actor call (runs on the IO loop): execute in arrival
+        order, reply via the per-connection batched pusher."""
+        pusher = self._done_pushers.get(conn)
+        if pusher is None:
+            pusher = self._done_pushers[conn] = _BatchPusher(conn, "tasks_done", "done")
+
+        def reply_cb(reply: dict, _p=pusher, _tid=spec.task_id):
+            _p.add({**reply, "task_id": _tid})
+
+        self.exec_queue.put(("actor_task", spec, reply_cb))
+
     def _cancel_current(self, task_id: str):
         """Non-force cancel: raise KeyboardInterrupt in the executing thread
         (reference: ray.cancel() delivers KeyboardInterrupt to the worker's
@@ -107,14 +166,6 @@ class WorkerProc:
             ctypes.pythonapi.PyThreadState_SetAsyncExc(
                 ctypes.c_ulong(self._exec_thread_ident), ctypes.py_object(KeyboardInterrupt))
 
-    async def _handle_actor_call(self, spec: TaskSpec):
-        """Called on the IO thread for direct actor calls; bridges to the
-        execution thread and awaits the reply."""
-        loop = asyncio.get_running_loop()
-        fut = loop.create_future()
-        self.exec_queue.put(("actor_task", spec, (loop, fut)))
-        return await fut
-
     # ---------------------------------------------------------- exec loop
     def run(self):
         self._exec_thread_ident = threading.get_ident()
@@ -126,7 +177,9 @@ class WorkerProc:
             if kind == "exit":
                 break
             try:
-                if spec.kind == ACTOR_TASK:
+                if kind == "ltask":
+                    self._execute_leased_task(spec, reply_slot)
+                elif spec.kind == ACTOR_TASK:
                     self._dispatch_actor_task(spec, reply_slot)
                 else:
                     self._execute_task(spec)
@@ -179,9 +232,7 @@ class WorkerProc:
             return self._finish_actor_task(spec, value, error_blob)
 
     def _reply_value(self, reply_slot, reply: dict):
-        loop, fut = reply_slot
-        loop.call_soon_threadsafe(
-            lambda f=fut, r=reply: f.set_result(r) if not f.done() else None)
+        reply_slot(reply)  # thread-safe callable (per-conn batched pusher)
 
     def _reply_future(self, reply_slot, done_future):
         try:
@@ -351,6 +402,68 @@ class WorkerProc:
             except KeyboardInterrupt:
                 continue
 
+    def _execute_leased_task(self, spec: TaskSpec, conn):
+        """Direct-path execution: results go straight back to the lease
+        holder over the connection the spec arrived on (batched), and are
+        advertised to the controller's object directory in batched frames
+        for third-party borrowers. No per-task agent involvement — the slot
+        stays leased (reference: executing a PushNormalTask on a leased
+        worker, task_receiver.h:51)."""
+        error_blob = None
+        value = None
+        retryable = False
+        saved_env: dict[str, str | None] = {}
+        env_vars = spec.runtime_env.get("env_vars") or {}
+        for k, v in env_vars.items():
+            saved_env[k] = os.environ.get(k)
+            os.environ[k] = str(v)
+        self._current_task_id = spec.task_id
+        try:
+            if spec.task_id in self._cancel_requested:
+                self._cancel_requested.discard(spec.task_id)
+                raise KeyboardInterrupt  # cancelled before it started
+            fn = self.worker.load_function(spec.function_id)
+            args, kwargs = self.worker.decode_args(spec.args, spec.kwargs)
+            value = fn(*args, **kwargs)
+        except BaseException as e:  # noqa: BLE001 — user code may raise anything
+            error_blob = self._make_error_blob(spec, e)
+            retryable = self._exception_retryable(spec, e)
+        finally:
+            self._current_task_id = None
+            for k, old in saved_env.items():
+                if old is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = old
+        try:
+            results = self._package_results(spec, value, error_blob)
+        except KeyboardInterrupt:
+            results = self._package_results(spec, value, error_blob)
+        except BaseException as e:
+            error_blob = self._make_error_blob(spec, e)
+            results = self._package_results(spec, None, error_blob)
+
+        pusher = self._done_pushers.get(conn)
+        if pusher is None:
+            pusher = self._done_pushers[conn] = _BatchPusher(conn, "tasks_done", "done")
+        payload = {"task_id": spec.task_id, "attempt": spec.attempt,
+                   "results": results, "error": error_blob, "retryable": retryable}
+        # Don't advertise transient (to-be-retried) errors: the owner will
+        # resubmit, and a poisoned directory entry would outlive the retry.
+        will_retry = (error_blob is not None and retryable
+                      and spec.attempt < spec.max_retries)
+        if not will_retry:
+            for oid, inline, size, holder in results:
+                self._advertise_pusher.add(
+                    {"oid": oid, "size": size, "inline": inline, "holder": holder,
+                     "owner": spec.owner_id, "error": error_blob})
+        for _ in range(2):  # a late cancel SIGINT must not lose the report
+            try:
+                pusher.add(payload)
+                break
+            except KeyboardInterrupt:
+                continue
+
     def _execute_actor_task(self, spec: TaskSpec) -> dict:
         error_blob = None
         value = None
@@ -371,16 +484,13 @@ class WorkerProc:
             error_blob = self._make_error_blob(spec, e)
             results = self._package_results(spec, None, error_blob)
 
-        # Advertise results to the controller (async push) so refs passed on
-        # to third parties resolve; the caller gets them in the reply already.
-        async def _advertise():
-            for oid, inline, size, holder in results:
-                await self.worker.controller.push(
-                    "register_put", oid=oid, size=size, inline=inline,
-                    holder=holder, owner=spec.owner_id, error=error_blob)
-
-        if results:
-            self.worker.io.spawn(_advertise())
+        # Advertise results to the controller (batched one-way frames) so
+        # refs passed on to third parties resolve; the caller gets them in
+        # the reply already.
+        for oid, inline, size, holder in results:
+            self._advertise_pusher.add(
+                {"oid": oid, "size": size, "inline": inline, "holder": holder,
+                 "owner": spec.owner_id, "error": error_blob})
         return {"results": results, "error": error_blob}
 
 
