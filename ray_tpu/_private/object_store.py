@@ -391,8 +391,14 @@ class LocalStore:
         pushes for segments created by its (possibly exited) workers."""
         with self._lock:
             if oid in self._objects:
+                # delete() on an attached entry (created=False — the normal
+                # agent state after serving fetch_object for a worker-produced
+                # result) only drops our pin; the producing worker has already
+                # detach()ed, so nobody else will ever unlink the primary.
+                # Fall through and remove the names ourselves. Safe for
+                # created entries too: the recycle path renames the primary
+                # away before pooling it, so this unlink is a no-op there.
                 self.delete(oid)
-                return
             try:
                 os.unlink(self._path(oid))
             except OSError:
