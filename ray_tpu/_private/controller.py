@@ -505,13 +505,11 @@ class Controller:
         normal_task_submitter.cc)."""
         import uuid
 
+        import copy
+
         owner = conn.meta.get("worker_id") or a.get("owner_id")
         demand = ResourceSet(_raw=a["resources"])
         strategy = a["strategy"]
-        if isinstance(conn, rpc.LocalConnection):
-            s = strategy
-            strategy = type(s)()
-            strategy.__setstate__(s.__getstate__())
         granted = []
         for _ in range(max(1, min(int(a.get("count", 1)), 64))):
             nid = pick_node(demand, strategy, self.nodes, self.pg_bundles)
@@ -520,7 +518,14 @@ class Controller:
             nconn = self.node_conns.get(nid)
             if nconn is None or nconn.closed:
                 break
-            self._consume_for(nid, strategy, demand)
+            # Consume against a per-lease CLONE: _consume_for pins a
+            # pg_bundle_index=-1 wildcard to the bundle it consumed, and that
+            # pin must not leak into later iterations of this grant loop (or
+            # every lease of a multi-count grant collapses onto one bundle's
+            # capacity), into the lease entries, or — on the in-process
+            # LocalConnection path — into the caller's live strategy object.
+            lease_strategy = copy.copy(strategy)
+            self._consume_for(nid, lease_strategy, demand)
             try:
                 # Margin over the agent's own acquire timeout: if the agent
                 # raises first we get a clean error reply; timing out here
@@ -528,7 +533,7 @@ class Controller:
                 rep = await nconn.call(
                     "lease_worker", _timeout=CONFIG.worker_register_timeout_s + 5)
             except Exception:
-                self._release_for(nid, strategy, demand)
+                self._release_for(nid, lease_strategy, demand)
                 break
             lease_id = uuid.uuid4().hex[:16]
             self.leases[lease_id] = {
@@ -536,7 +541,7 @@ class Controller:
                 "node_id": nid,
                 "worker_id": rep["worker_id"],
                 "demand": demand.raw(),
-                "strategy": strategy,
+                "strategy": lease_strategy,
             }
             granted.append({
                 "lease_id": lease_id,
@@ -596,13 +601,18 @@ class Controller:
         will follow to release the resources."""
         for lease_id, ent in list(self.leases.items()):
             if ent["worker_id"] == a["worker_id"]:
-                self._drop_lease(lease_id)
+                # Only claim the kill once the push to the node agent was
+                # actually sent: the caller un-dooms the lease on killed=False
+                # and would otherwise wait forever for a death that is never
+                # coming (the lease must also survive here in that case).
                 nconn = self.node_conns.get(ent["node_id"])
-                if nconn is not None and not nconn.closed:
-                    try:
-                        await nconn.push("kill_worker", worker_id=ent["worker_id"])
-                    except Exception:
-                        pass
+                if nconn is None or nconn.closed:
+                    return {"killed": False}
+                try:
+                    await nconn.push("kill_worker", worker_id=ent["worker_id"])
+                except Exception:
+                    return {"killed": False}
+                self._drop_lease(lease_id)
                 return {"killed": True}
         return {"killed": False}
 

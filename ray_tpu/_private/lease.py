@@ -46,7 +46,7 @@ def _class_key(spec: TaskSpec) -> tuple:
 
 class _Lease:
     __slots__ = ("lease_id", "worker_id", "node_id", "addr", "conn", "inflight",
-                 "buf", "flushing", "dead", "idle_since", "cls")
+                 "buf", "flushing", "dead", "idle_since", "cls", "kill_target")
 
     def __init__(self, cls, lease_id: str, worker_id: str, node_id: str, addr: tuple):
         self.cls = cls
@@ -60,6 +60,10 @@ class _Lease:
         self.flushing = False
         self.dead = False
         self.idle_since = time.monotonic()
+        # task_id being force-cancelled via worker kill; while set, the lease
+        # takes no new work and _lease_failed requeues innocent bystanders
+        # without burning an attempt.
+        self.kill_target: Optional[str] = None
 
 
 class _Class:
@@ -118,8 +122,10 @@ class LeaseManager:
             self._idle_task = asyncio.ensure_future(self._a_idle_loop())
 
     def _pump(self, cls: _Class):
-        # Assign queued specs to the least-loaded live leases.
-        live = [l for l in cls.leases.values() if not l.dead]
+        # Assign queued specs to the least-loaded live leases (skip leases
+        # whose worker is being force-kill-cancelled: it is already doomed).
+        live = [l for l in cls.leases.values()
+                if not l.dead and l.kill_target is None]
         while cls.queue and live:
             lease = min(live, key=lambda l: len(l.inflight))
             if len(lease.inflight) >= cls.depth:
@@ -270,12 +276,34 @@ class LeaseManager:
         if lease.conn is not None:
             self._by_conn.pop(lease.conn, None)
         requeue = []
+        # Specs still in lease.buf provably never reached the worker; of the
+        # rest, worker exec order == arrival order and _task_done pops
+        # completions, so the OLDEST remaining SENT spec is the one that may
+        # have been executing when the worker died; everything younger never
+        # started.
+        unsent = {s.task_id for s in lease.buf}
+        executing_candidate = next(
+            (tid for tid in lease.inflight if tid not in unsent), None)
         for spec in lease.inflight.values():
             force = self._cancelled.pop(spec.task_id, None)
             if force is not None:
                 self._fail_spec(spec, {
                     "type": "WorkerCrashedError" if force else "TaskCancelledError",
                     "message": f"task {spec.name} cancelled"})
+            elif spec.task_id in unsent:
+                # Never sent: requeue without burning an attempt, whatever
+                # killed the worker.
+                requeue.append(spec)
+            elif (lease.kill_target is not None
+                  and spec.task_id != executing_candidate):
+                # The worker was killed to force-cancel ONE task; this spec is
+                # an unstarted bystander pipelined behind it (a reference
+                # leased worker runs one task at a time, so it has no such
+                # collateral). Requeue WITHOUT burning a retry attempt. The
+                # executing candidate deliberately falls through to normal
+                # retry semantics: re-running a possibly-started task for
+                # free could duplicate side effects of a max_retries=0 task.
+                requeue.append(spec)
             elif spec.attempt < spec.max_retries:
                 spec.attempt += 1
                 requeue.append(spec)
@@ -300,37 +328,96 @@ class LeaseManager:
 
     # -------------------------------------------------------- cancellation
     def cancel(self, task_id: str, force: bool) -> bool:
-        """True if the task is managed here (queued or in flight)."""
-        with self._lock:
-            for cls in self.classes.values():
-                for spec in cls.queue:
-                    if spec.task_id == task_id:
-                        cls.queue.remove(spec)
-                        self._fail_spec(spec, {
-                            "type": "TaskCancelledError",
-                            "message": f"task {spec.name} cancelled"})
-                        return True
-        for lease in list(self._by_id.values()):
-            spec = lease.inflight.get(task_id)
-            if spec is None:
+        """True if the task is managed here (queued or in flight).
+
+        Called from the user's thread, but every structure it touches beyond
+        the lock-guarded class queues (lease.inflight, lease.buf) is owned by
+        loop-side code (_pump/_task_done/_a_flush), so the scan+mutation runs
+        as one atomic step ON the IO loop."""
+
+        async def _go() -> bool:
+            with self._lock:
+                for cls in self.classes.values():
+                    for spec in cls.queue:
+                        if spec.task_id == task_id:
+                            cls.queue.remove(spec)
+                            self._fail_spec(spec, {
+                                "type": "TaskCancelledError",
+                                "message": f"task {spec.name} cancelled"})
+                            return True
+            for lease in list(self._by_id.values()):
+                spec = lease.inflight.get(task_id)
+                if spec is None:
+                    continue
+                self._cancelled[task_id] = force
+                spec.max_retries = 0  # never retry a cancelled task
+                if spec in lease.buf:
+                    # Never sent to the worker: unbuffer and fail immediately
+                    # (reference cancels pre-dispatch tasks synchronously).
+                    # Applies to force too — killing the worker for a spec it
+                    # never received would only hurt innocent neighbors.
+                    lease.buf.remove(spec)
+                    lease.inflight.pop(task_id, None)
+                    self._cancelled.pop(task_id, None)
+                    self._fail_spec(spec, {"type": "TaskCancelledError",
+                                           "message": f"task {spec.name} cancelled"})
+                elif force:
+                    # Kill the worker, but do NOT requeue pipelined neighbors
+                    # yet: they are requeued (attempt intact) by _lease_failed
+                    # once the death is actually observed, so a neighbor can
+                    # never run twice concurrently. Setting kill_target takes
+                    # the lease out of _pump rotation immediately.
+                    lease.kill_target = task_id
+                    asyncio.ensure_future(
+                        self._a_kill_for_cancel(lease, task_id))
+                else:
+                    # Already on the worker (queued or executing there).
+                    # Don't guess the outcome: push the cancel and let the
+                    # worker's tasks_done report decide — a value if the task
+                    # wins the race (reference: ray.cancel losing the race
+                    # delivers the value), a TaskCancelledError if the
+                    # interrupt/skip wins.
+                    if lease.conn is not None:
+                        asyncio.ensure_future(
+                            lease.conn.push("cancel", task_id=task_id))
+                return True
+            return False
+
+        return self.w.io.run(_go())
+
+    async def _a_kill_for_cancel(self, lease: _Lease, task_id: str):
+        """Deliver a force-cancel kill, then make sure the doomed state
+        resolves: a lease must never stay out of _pump rotation forever.
+
+        - kill delivered → wait (bounded) for the death to arrive as a conn
+          close; if it never does (kill push lost downstream), declare the
+          lease failed ourselves so the class unblocks.
+        - kill undeliverable (lease already torn down, controller blip) →
+          un-doom: force cancel is best-effort in the reference too — the
+          task then simply runs to completion and tasks_done decides the
+          ref's outcome."""
+        delivered = False
+        for attempt in range(2):
+            try:
+                rep = await self.w.controller.call(
+                    "kill_leased_worker", worker_id=lease.worker_id)
+            except Exception:
+                await asyncio.sleep(0.2)
                 continue
-            self._cancelled[task_id] = force
-            spec.max_retries = 0  # never retry a cancelled task
-            if force:
-                self.w.io.spawn(self.w.controller.call(
-                    "kill_leased_worker", worker_id=lease.worker_id))
-            else:
-                # Resolve the caller NOW (the spec may be queued behind a
-                # long task in the worker's pipeline — reference cancels
-                # pre-dispatch tasks immediately); the worker-side interrupt
-                # or skip still runs, and a value that races in later is a
-                # benign overwrite.
-                if lease.conn is not None:
-                    self.w.io.spawn(lease.conn.push("cancel", task_id=task_id))
-                self._fail_spec(spec, {"type": "TaskCancelledError",
-                                       "message": f"task {spec.name} cancelled"})
-            return True
-        return False
+            delivered = bool(rep.get("killed"))
+            break
+        # Grace period even when undeliverable: a concurrent kill (second
+        # force-cancel on the same lease) may already be felling the worker.
+        deadline = time.monotonic() + (10.0 if delivered else 1.0)
+        while not lease.dead and time.monotonic() < deadline:
+            await asyncio.sleep(0.05)
+        if lease.dead:
+            return
+        if delivered:
+            self._lease_failed(lease, release=False)
+        elif lease.kill_target == task_id:
+            lease.kill_target = None
+            self._pump(lease.cls)
 
     # ------------------------------------------------------ lease returns
     async def _a_idle_loop(self):

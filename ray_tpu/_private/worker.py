@@ -158,7 +158,8 @@ class Worker:
         self.node_id = node_id
         self.worker_id = worker_id or WorkerID.from_random().hex()
         self.io = rpc.EventLoopThread(name=f"rt-io-{self.worker_id[:6]}")
-        self.server = rpc.RpcServer(self._on_request, self._on_push)
+        self.server = rpc.RpcServer(self._on_request, self._on_push,
+                                    on_close=self._on_server_conn_close)
         self.store = LocalStore(session_id, CONFIG.object_store_memory_bytes,
                                 CONFIG.object_spill_dir, CONFIG.shm_dir)
         self.controller: Optional[rpc.Connection] = None
@@ -189,6 +190,9 @@ class Worker:
         # Hooks used by worker_proc for the direct (leased) task path:
         self.task_push_handler = None  # def (conn, spec) — enqueue for exec
         self.task_cancel_handler = None  # def (task_id)
+        # Fires when an inbound connection to this worker's server closes
+        # (worker_proc prunes per-connection reply pushers here).
+        self.server_close_handler = None  # def (conn)
         from ray_tpu._private.lease import LeaseManager
 
         self.lease_mgr = LeaseManager(self)
@@ -234,6 +238,11 @@ class Worker:
         self.store.shutdown()
         if global_worker() is self:
             set_global_worker(None)
+
+    def _on_server_conn_close(self, conn):
+        h = self.server_close_handler
+        if h is not None:
+            h(conn)
 
     def _on_ctrl_close(self, conn):
         if not self._shutdown and self.mode == _MODE_WORKER:

@@ -95,6 +95,10 @@ class WorkerProc:
         self._exec_thread_ident: int | None = None
         self._current_task_id: str | None = None
         self._cancel_requested: set[str] = set()  # cancels that beat the task
+        # Leased-path specs accepted but not yet started: task_id -> (spec,
+        # conn). Lets a cancel that arrives while the exec thread is blocked
+        # in an earlier task report the cancellation immediately.
+        self._pending_ltasks: dict = {}
         self._done_pushers: dict = {}  # owner conn -> _BatchPusher
         self._advertise_pusher: _BatchPusher | None = None
         self._running = True
@@ -106,6 +110,9 @@ class WorkerProc:
         self.worker.actor_push_handler = self._on_actor_push
         self.worker.task_push_handler = self._on_task_push
         self.worker.task_cancel_handler = self._cancel_current
+        # Long-lived pool workers serve many lease holders; drop a holder's
+        # batched reply pusher when its connection goes away.
+        self.worker.server_close_handler = lambda conn: self._done_pushers.pop(conn, None)
         self._advertise_pusher = _BatchPusher(
             self.worker.controller, "register_puts", "items")
 
@@ -132,17 +139,32 @@ class WorkerProc:
 
     def _on_task_push(self, conn, spec: TaskSpec):
         """Direct-path spec from a lease holder (runs on the IO loop)."""
+        self._pending_ltasks[spec.task_id] = (spec, conn)
         self.exec_queue.put(("ltask", spec, conn))
+
+    def _pusher_for(self, conn) -> "_BatchPusher | None":
+        """Per-connection batched reply pusher; None once the holder's
+        connection has closed (never re-create an entry for a dead conn —
+        its on_close already fired and nothing would ever prune it again)."""
+        pusher = self._done_pushers.get(conn)
+        if pusher is None and not conn.closed:
+            pusher = self._done_pushers[conn] = _BatchPusher(conn, "tasks_done", "done")
+            if conn.closed:
+                # Raced with the close between the check and the insert: the
+                # on_close prune may have already run and found nothing, so
+                # prune our own insert (the returned pusher still works — its
+                # flush just fails against the dead conn).
+                self._done_pushers.pop(conn, None)
+        return pusher
 
     def _on_actor_push(self, conn, spec: TaskSpec):
         """Pipelined actor call (runs on the IO loop): execute in arrival
         order, reply via the per-connection batched pusher."""
-        pusher = self._done_pushers.get(conn)
-        if pusher is None:
-            pusher = self._done_pushers[conn] = _BatchPusher(conn, "tasks_done", "done")
+        pusher = self._pusher_for(conn)
 
         def reply_cb(reply: dict, _p=pusher, _tid=spec.task_id):
-            _p.add({**reply, "task_id": _tid})
+            if _p is not None:
+                _p.add({**reply, "task_id": _tid})
 
         self.exec_queue.put(("actor_task", spec, reply_cb))
 
@@ -157,6 +179,25 @@ class WorkerProc:
             # The execute push may still be queued ahead of us: remember the
             # cancel so the exec loop aborts the task before running it.
             self._cancel_requested.add(task_id)
+            ent = self._pending_ltasks.pop(task_id, None)
+            if ent is not None:
+                # The spec provably hasn't started and the exec thread may be
+                # blocked in a long task ahead of it — report the
+                # cancellation NOW (we're on the IO loop) so the owner isn't
+                # held hostage by the pipeline head (reference cancels
+                # pre-dispatch tasks promptly). The exec loop's own
+                # before-start abort later reports again; the owner ignores
+                # the duplicate (spec already popped from inflight).
+                spec, conn = ent
+                h, bufs = dumps_oob({"type": "TaskCancelledError",
+                                     "message": f"task {spec.name} cancelled"})
+                pusher = self._pusher_for(conn)
+                if pusher is not None:
+                    pusher.add({
+                        "task_id": spec.task_id, "attempt": spec.attempt,
+                        "results": [(oid, None, 0, None)
+                                    for oid in spec.return_object_ids()],
+                        "error": [h, *bufs], "retryable": False})
             return
         if self._exec_thread_ident == threading.main_thread().ident:
             import signal
@@ -409,6 +450,7 @@ class WorkerProc:
         for third-party borrowers. No per-task agent involvement — the slot
         stays leased (reference: executing a PushNormalTask on a leased
         worker, task_receiver.h:51)."""
+        self._pending_ltasks.pop(spec.task_id, None)
         error_blob = None
         value = None
         retryable = False
@@ -443,9 +485,7 @@ class WorkerProc:
             error_blob = self._make_error_blob(spec, e)
             results = self._package_results(spec, None, error_blob)
 
-        pusher = self._done_pushers.get(conn)
-        if pusher is None:
-            pusher = self._done_pushers[conn] = _BatchPusher(conn, "tasks_done", "done")
+        pusher = self._pusher_for(conn)
         payload = {"task_id": spec.task_id, "attempt": spec.attempt,
                    "results": results, "error": error_blob, "retryable": retryable}
         # Don't advertise transient (to-be-retried) errors: the owner will
@@ -459,7 +499,8 @@ class WorkerProc:
                      "owner": spec.owner_id, "error": error_blob})
         for _ in range(2):  # a late cancel SIGINT must not lose the report
             try:
-                pusher.add(payload)
+                if pusher is not None:  # holder gone: report has no audience
+                    pusher.add(payload)
                 break
             except KeyboardInterrupt:
                 continue
