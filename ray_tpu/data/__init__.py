@@ -1,0 +1,49 @@
+"""ray_tpu.data: distributed datasets over the object store.
+
+Parity target: reference python/ray/data/__init__.py — Dataset +
+constructors (read_api.py) + datasources. Lazy logical plans execute as
+remote tasks with bounded in-flight streaming; blocks are columnar numpy
+dicts (TPU-friendly host format: feeds jnp.asarray without a copy for
+numeric dtypes).
+"""
+
+from ray_tpu.data.block import BlockAccessor
+from ray_tpu.data.dataset import DataIterator, Dataset, GroupedData
+from ray_tpu.data.datasource import Datasource, ReadTask
+from ray_tpu.data.read_api import (
+    from_arrow,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,  # noqa: A004 - reference name
+    range_tensor,
+    read_binary_files,
+    read_csv,
+    read_datasource,
+    read_json,
+    read_numpy,
+    read_parquet,
+    read_text,
+)
+
+__all__ = [
+    "BlockAccessor",
+    "DataIterator",
+    "Dataset",
+    "Datasource",
+    "GroupedData",
+    "ReadTask",
+    "from_arrow",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "range",
+    "range_tensor",
+    "read_binary_files",
+    "read_csv",
+    "read_datasource",
+    "read_json",
+    "read_numpy",
+    "read_parquet",
+    "read_text",
+]

@@ -39,6 +39,18 @@ class Read(LogicalOp):
         self.num_blocks = num_blocks
 
 
+class ReadSource(LogicalOp):
+    """Lazy datasource read: each ReadTask runs in a remote task when the
+    plan executes (reference read_api.read_datasource -> ReadTask tasks in
+    the streaming executor's first operator)."""
+
+    name = "ReadSource"
+
+    def __init__(self, tasks: list):
+        self.tasks = tasks  # list[ray_tpu.data.datasource.ReadTask]
+        self.num_blocks = len(tasks)
+
+
 class MapRows(LogicalOp):
     name = "Map"
 
@@ -63,9 +75,23 @@ class Filter(LogicalOp):
 class MapBatches(LogicalOp):
     name = "MapBatches"
 
-    def __init__(self, fn, batch_size: Optional[int]):
+    def __init__(self, fn, batch_size: Optional[int],
+                 batch_format: str = "numpy",
+                 concurrency: Optional[int] = None,
+                 fn_constructor_args: tuple = (),
+                 fn_constructor_kwargs: Optional[dict] = None):
         self.fn = fn
         self.batch_size = batch_size
+        self.batch_format = batch_format
+        # concurrency (or a class fn) switches execution to an actor pool
+        # (reference operators/map_operator.py:64 ActorPoolMapOperator).
+        self.concurrency = concurrency
+        self.fn_constructor_args = fn_constructor_args
+        self.fn_constructor_kwargs = fn_constructor_kwargs or {}
+
+    @property
+    def needs_actors(self) -> bool:
+        return self.concurrency is not None or isinstance(self.fn, type)
 
 
 class Repartition(LogicalOp):
@@ -119,12 +145,21 @@ def _apply_chain(block, chain):
         elif kind == "filter":
             block = [r for r in acc.iter_rows() if fn(r)]
         elif kind == "map_batches":
-            bs = arg or acc.num_rows() or 1
+            bs, fmt = arg if isinstance(arg, tuple) else (arg, "numpy")
+            bs = bs or acc.num_rows() or 1
             pieces = []
             n = acc.num_rows()
             for s in range(0, n, bs):
-                out = fn(acc.to_batch() if (s == 0 and bs >= n)
-                         else BlockAccessor.for_block(acc.slice(s, min(s + bs, n))).to_batch())
+                batch = (acc.to_batch() if (s == 0 and bs >= n)
+                         else BlockAccessor.for_block(
+                             acc.slice(s, min(s + bs, n))).to_batch())
+                if fmt == "pandas":
+                    import pandas as pd
+
+                    df = fn(pd.DataFrame(batch))
+                    out = {c: df[c].to_numpy() for c in df.columns}
+                else:
+                    out = fn(batch)
                 pieces.append(out)
             block = combine_blocks(pieces) if pieces else block
     return block
@@ -133,6 +168,28 @@ def _apply_chain(block, chain):
 @ray_tpu.remote
 def _transform_block(block, chain):
     return _apply_chain(block, chain)
+
+
+@ray_tpu.remote
+def _exec_read_task(task, chain):
+    """Run a datasource ReadTask (and any fused downstream per-block chain)
+    inside a worker: file parsing happens on the cluster, not the driver."""
+    block = task()
+    return _apply_chain(block, chain) if chain else block
+
+
+@ray_tpu.remote
+class _MapBatchesActor:
+    """Actor-pool map worker (reference ActorPoolMapOperator's _MapWorker):
+    a callable-class fn is constructed ONCE per actor — the pattern for
+    batch inference, where __init__ loads model weights."""
+
+    def __init__(self, fn, args, kwargs):
+        self.fn = fn(*args, **kwargs) if isinstance(fn, type) else fn
+
+    def apply(self, block, batch_size, batch_format="numpy"):
+        return _apply_chain(
+            block, [("map_batches", self.fn, (batch_size, batch_format))])
 
 
 @ray_tpu.remote
@@ -160,7 +217,8 @@ def _sort_block_local(block, key, descending):
 # -------------------------------------------------------------- execution
 def _fuse(plan: list) -> list:
     """Fuse consecutive per-row/batch ops into chains (reference fusion
-    rule, logical/optimizers.py)."""
+    rule, logical/optimizers.py). Actor-pool map_batches stages break the
+    chain: they execute on a dedicated actor pool."""
     fused: list = []
     chain: list = []
     for op in plan:
@@ -170,8 +228,8 @@ def _fuse(plan: list) -> list:
             chain.append(("flat_map", op.fn, None))
         elif isinstance(op, Filter):
             chain.append(("filter", op.fn, None))
-        elif isinstance(op, MapBatches):
-            chain.append(("map_batches", op.fn, op.batch_size))
+        elif isinstance(op, MapBatches) and not op.needs_actors:
+            chain.append(("map_batches", op.fn, (op.batch_size, op.batch_format)))
         else:
             if chain:
                 fused.append(("chain", chain))
@@ -182,14 +240,15 @@ def _fuse(plan: list) -> list:
     return fused
 
 
-def _windowed_map(refs: list, chain) -> list:
-    """Submit transform tasks with a bounded in-flight window (streaming)."""
-    out = [None] * len(refs)
+def _windowed_submit(items: list, submit) -> list:
+    """Submit one task per item with a bounded in-flight window (streaming
+    — reference streaming_executor's bounded operator concurrency)."""
+    out = [None] * len(items)
     in_flight: dict = {}
     i = 0
-    while i < len(refs) or in_flight:
-        while i < len(refs) and len(in_flight) < MAX_IN_FLIGHT:
-            out[i] = _transform_block.remote(refs[i], chain)
+    while i < len(items) or in_flight:
+        while i < len(items) and len(in_flight) < MAX_IN_FLIGHT:
+            out[i] = submit(items[i])
             in_flight[out[i]] = i
             i += 1
         if in_flight:
@@ -199,12 +258,107 @@ def _windowed_map(refs: list, chain) -> list:
     return out
 
 
+def _windowed_map(refs: list, chain) -> list:
+    return _windowed_submit(refs, lambda r: _transform_block.remote(r, chain))
+
+
+def _actor_pool_map(refs: list, op: "MapBatches") -> list:
+    """Run a map_batches stage on a pool of actors: least-loaded dispatch
+    with a small per-actor pipeline (reference ActorPoolMapOperator +
+    _ActorPool in operators/actor_pool_map_operator.py)."""
+    n = max(1, min(op.concurrency or 1, len(refs) or 1))
+    actors = [_MapBatchesActor.remote(op.fn, tuple(op.fn_constructor_args),
+                                      dict(op.fn_constructor_kwargs))
+              for _ in range(n)]
+    try:
+        out = [None] * len(refs)
+        pending: dict = {}  # result ref -> actor index
+        load = [0] * n
+        i = 0
+        while i < len(refs) or pending:
+            while i < len(refs) and min(load) < 2:
+                ai = load.index(min(load))
+                r = actors[ai].apply.remote(refs[i], op.batch_size,
+                                            op.batch_format)
+                out[i] = r
+                pending[r] = ai
+                load[ai] += 1
+                i += 1
+            if pending:
+                done, _ = ray_tpu.wait(list(pending), num_returns=1, timeout=10)
+                for d in done:
+                    load[pending.pop(d)] -= 1
+        # Results are resolved (inline or node-shm with the agent as holder),
+        # so the pool can be torn down before downstream consumption.
+        return out
+    finally:
+        for a in actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+
+
+def _equal_split(refs: list, n: int) -> list[list]:
+    """Split blocks into n shards with IDENTICAL row counts (total//n each,
+    remainder dropped) — lockstep allreduce training hangs on unequal shards
+    (reference streaming_split(equal=True) -> equalize splits)."""
+    sizes = _block_sizes(refs)
+    total = sum(sizes)
+    per = total // n
+    shards: list[list] = [[] for _ in range(n)]
+    if per == 0:
+        return shards
+    si, need = 0, per
+    for ref, size in zip(refs, sizes):
+        # Plan this block's cuts: (target shard | None to drop, row count).
+        parts: list[tuple[Optional[int], int]] = []
+        off = 0
+        while off < size:
+            if si >= n:
+                parts.append((None, size - off))  # remainder: dropped
+                break
+            take = min(size - off, need)
+            parts.append((si, take))
+            off += take
+            need -= take
+            if need == 0:
+                si += 1
+                need = per
+        if len(parts) == 1 and parts[0][0] is not None:
+            shards[parts[0][0]].append(ref)
+            continue
+        # Cut in a remote task with one return per piece: payloads never
+        # visit the driver (streaming_split feeds trainers with datasets
+        # larger than driver memory).
+        prefs = _split_block.options(num_returns=len(parts)).remote(
+            ref, [t for _s, t in parts])
+        if not isinstance(prefs, list):
+            prefs = [prefs]
+        for (sidx, _t), pref in zip(parts, prefs):
+            if sidx is not None:
+                shards[sidx].append(pref)
+    return shards
+
+
 def execute(plan: list) -> list:
     """Run the logical plan, returning block refs."""
-    assert plan and isinstance(plan[0], Read)
-    refs = [b if isinstance(b, ray_tpu.ObjectRef) else ray_tpu.put(b)
-            for b in plan[0].blocks_fn()]
-    for kind, item in _fuse(plan[1:]):
+    assert plan and isinstance(plan[0], (Read, ReadSource))
+    fused = _fuse(plan[1:])
+    if isinstance(plan[0], ReadSource):
+        # Fuse the first per-block chain straight into the read tasks: one
+        # remote task parses AND transforms each block (reference
+        # read->map fusion).
+        read_chain = None
+        if fused and fused[0][0] == "chain":
+            read_chain = fused.pop(0)[1]
+        refs = _windowed_submit(
+            plan[0].tasks,
+            lambda t: _exec_read_task.remote(t, read_chain))
+    else:
+        refs = [b if isinstance(b, ray_tpu.ObjectRef) else ray_tpu.put(b)
+                for b in plan[0].blocks_fn()]
+    for kind, item in fused:
         if kind == "chain":
             refs = _windowed_map(refs, item)
             continue
@@ -219,13 +373,21 @@ def execute(plan: list) -> list:
             refs = _limit(refs, op.n)
         elif isinstance(op, Union):
             refs = refs + execute(op.other_plan)
+        elif isinstance(op, MapBatches):  # actor-pool stage
+            refs = _actor_pool_map(refs, op)
         else:
             raise ValueError(f"unknown op {op.name}")
     return refs
 
 
+@ray_tpu.remote
+def _count_rows(block) -> int:
+    return BlockAccessor.for_block(block).num_rows()
+
+
 def _block_sizes(refs: list) -> list[int]:
-    return [BlockAccessor.for_block(b).num_rows() for b in ray_tpu.get(refs, timeout=600)]
+    """Row counts WITHOUT pulling block payloads to the driver."""
+    return ray_tpu.get([_count_rows.remote(r) for r in refs], timeout=600)
 
 
 def _repartition(refs: list, k: int) -> list:
@@ -254,10 +416,14 @@ def _repartition(refs: list, k: int) -> list:
         if len(parts) == 1:
             pieces[parts[0][0]].append(ref)
             continue
-        split_ref = _split_block.options(num_returns=1).remote(ref, [p[1] for p in parts])
-        sub = ray_tpu.get(split_ref, timeout=600)
-        for (pi, _), piece in zip(parts, sub if isinstance(sub, list) else [sub]):
-            pieces[pi].append(ray_tpu.put(piece))
+        # Multi-return split: piece refs only — payloads never visit the
+        # driver (reference exchange tasks are fully distributed too).
+        prefs = _split_block.options(num_returns=len(parts)).remote(
+            ref, [p[1] for p in parts])
+        if not isinstance(prefs, list):
+            prefs = [prefs]
+        for (pi, _), pref in zip(parts, prefs):
+            pieces[pi].append(pref)
     return [_merge_blocks.remote(*pieces[i]) if len(pieces[i]) != 1 else pieces[i][0]
             for i in range(k) if pieces[i]]
 

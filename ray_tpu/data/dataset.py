@@ -37,8 +37,39 @@ class Dataset:
     def filter(self, fn: Callable) -> "Dataset":
         return self._extend(ex.Filter(fn))
 
-    def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None) -> "Dataset":
-        return self._extend(ex.MapBatches(fn, batch_size))
+    def map_batches(self, fn: Callable, *, batch_size: Optional[int] = None,
+                    batch_format: str = "numpy",
+                    concurrency: Optional[int] = None,
+                    fn_constructor_args: tuple = (),
+                    fn_constructor_kwargs: Optional[dict] = None) -> "Dataset":
+        """Batch transform. A callable CLASS (or concurrency=N) runs on an
+        actor pool — __init__ once per actor, the batch-inference pattern
+        (reference dataset.py map_batches + ActorPoolMapOperator).
+        batch_format: "numpy" (dict of arrays, the TPU-feed format) or
+        "pandas" (DataFrame in, DataFrame out)."""
+        if batch_format not in ("numpy", "default", "pandas"):
+            raise ValueError(f"unsupported batch_format {batch_format!r}")
+        return self._extend(ex.MapBatches(
+            fn, batch_size, batch_format=batch_format, concurrency=concurrency,
+            fn_constructor_args=fn_constructor_args,
+            fn_constructor_kwargs=fn_constructor_kwargs))
+
+    def add_column(self, name: str, fn: Callable) -> "Dataset":
+        def _add(batch, _name=name, _fn=fn):
+            batch[_name] = _fn(batch)
+            return batch
+
+        return self._extend(ex.MapBatches(_add, None))
+
+    def drop_columns(self, cols: list[str]) -> "Dataset":
+        drop = set(cols)
+        return self._extend(ex.MapBatches(
+            lambda b: {k: v for k, v in b.items() if k not in drop}, None))
+
+    def select_columns(self, cols: list[str]) -> "Dataset":
+        keep = list(cols)
+        return self._extend(ex.MapBatches(
+            lambda b: {k: b[k] for k in keep}, None))
 
     def repartition(self, num_blocks: int) -> "Dataset":
         return self._extend(ex.Repartition(num_blocks))
@@ -121,8 +152,13 @@ class Dataset:
 
     def streaming_split(self, n: int, *, equal: bool = True) -> list["DataIterator"]:
         """Split into n iterators for n training workers (reference
-        Dataset.streaming_split feeding get_dataset_shard)."""
+        Dataset.streaming_split feeding get_dataset_shard). equal=True
+        (the training default) gives every shard EXACTLY total//n rows,
+        dropping the remainder — unequal shards hang lockstep allreduce
+        training."""
         refs = self._block_refs()
+        if equal:
+            return [DataIterator(s) for s in ex._equal_split(refs, n)]
         if len(refs) < n:
             refs = ex._repartition(refs, n)
         shards: list[list] = [[] for _ in range(n)]
@@ -134,9 +170,179 @@ class Dataset:
         return [Dataset([ex.Read(lambda s=s: list(s._refs), len(s._refs))])
                 for s in self.streaming_split(n)]
 
+    # ------------------------------------------------------------- writes
+    def _write(self, path: str, fmt: str, ext: str) -> list[str]:
+        """One output file per block, written by remote tasks (reference
+        write_parquet/_csv/_json -> per-block write tasks)."""
+        import os
+
+        os.makedirs(path, exist_ok=True)
+        refs = self._block_refs()
+        outs = [_write_block.remote(ref, os.path.join(
+            path, f"part-{i:05d}.{ext}"), fmt) for i, ref in enumerate(refs)]
+        return ray_tpu.get(outs, timeout=600)
+
+    def write_parquet(self, path: str) -> list[str]:
+        return self._write(path, "parquet", "parquet")
+
+    def write_csv(self, path: str) -> list[str]:
+        return self._write(path, "csv", "csv")
+
+    def write_json(self, path: str) -> list[str]:
+        return self._write(path, "json", "json")
+
+    # --------------------------------------------------------- aggregates
+    def _agg(self, on: Optional[str], np_fn, combine):
+        refs = self._block_refs()
+        if on is None:
+            # Resolve the column ONCE from the schema so every block
+            # aggregates the same column; require it to be unambiguous.
+            schema = self.schema() or {}
+            cols = list(schema)
+            if len(cols) != 1:
+                raise ValueError(
+                    f"dataset has columns {cols}; pass on=<column> to aggregate")
+            on = cols[0]
+        parts = []
+        for ref in refs:
+            batch = BlockAccessor.for_block(ray_tpu.get(ref, timeout=600)).to_batch()
+            if not batch:
+                continue
+            if on not in batch:
+                raise KeyError(f"block is missing aggregation column {on!r} "
+                               f"(has {list(batch)})")
+            v = batch[on]
+            if len(v):
+                parts.append(np_fn(v))
+        return combine(parts) if parts else None
+
+    def sum(self, on: Optional[str] = None):
+        return self._agg(on, np.sum, sum)
+
+    def min(self, on: Optional[str] = None):
+        return self._agg(on, np.min, min)
+
+    def max(self, on: Optional[str] = None):
+        return self._agg(on, np.max, max)
+
+    def mean(self, on: Optional[str] = None):
+        tot = self._agg(on, lambda v: (np.sum(v), len(v)),
+                        lambda ps: tuple(map(sum, zip(*ps))))
+        if tot is None:
+            return None
+        s, n = tot
+        return s / n if n else None
+
+    def groupby(self, key) -> "GroupedData":
+        return GroupedData(self, key)
+
     def __repr__(self):
         names = [type(op).__name__ for op in self._plan]
         return f"Dataset(plan={' -> '.join(names)})"
+
+
+@ray_tpu.remote
+def _write_block(block, path: str, fmt: str) -> str:
+    import pyarrow as pa
+
+    batch = BlockAccessor.for_block(block).to_batch()
+    table = pa.table({k: pa.array(v) for k, v in batch.items()})
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+
+        pq.write_table(table, path)
+    elif fmt == "csv":
+        import pyarrow.csv as pacsv
+
+        pacsv.write_csv(table, path)
+    elif fmt == "json":
+        import json
+
+        with open(path, "w") as f:
+            for row in BlockAccessor.for_block(block).iter_rows():
+                f.write(json.dumps(
+                    {k: (v.item() if hasattr(v, "item") else v)
+                     for k, v in row.items()} if isinstance(row, dict)
+                    else row) + "\n")
+    else:
+        raise ValueError(f"unknown write format {fmt}")
+    return path
+
+
+@ray_tpu.remote
+def _partial_group(block, key, on):
+    """Map-side partial aggregation: key -> (rows, values, sum, min, max).
+    `values` counts rows that actually carry the aggregation column — mean
+    must divide by it, not by the row count."""
+    acc = BlockAccessor.for_block(block)
+    out: dict = {}
+    kf = key if callable(key) else (
+        lambda r: r[key] if isinstance(r, dict) else r)
+    for row in acc.iter_rows():
+        k = kf(row)
+        v = row.get(on) if (on is not None and isinstance(row, dict)) else None
+        c, vc, s, mn, mx = out.get(k, (0, 0, 0.0, None, None))
+        c += 1
+        if v is not None:
+            vc += 1
+            s += v
+            mn = v if mn is None else min(mn, v)
+            mx = v if mx is None else max(mx, v)
+        out[k] = (c, vc, s, mn, mx)
+    return out
+
+
+class GroupedData:
+    """groupby aggregations via map-side partial agg + driver combine
+    (reference grouped_data.py; the reference shuffles — at this scale a
+    tree-combine of partial states is the same result cheaper)."""
+
+    def __init__(self, ds: Dataset, key):
+        self._ds = ds
+        self._key = key
+
+    def _combined(self, on: Optional[str]) -> dict:
+        parts = ray_tpu.get(
+            [_partial_group.remote(r, self._key, on)
+             for r in self._ds._block_refs()], timeout=600)
+        merged: dict = {}
+        for part in parts:
+            for k, (c, vc, s, mn, mx) in part.items():
+                C, VC, S, MN, MX = merged.get(k, (0, 0, 0.0, None, None))
+                merged[k] = (
+                    C + c, VC + vc, S + s,
+                    mn if MN is None else (MN if mn is None else min(MN, mn)),
+                    mx if MX is None else (MX if mx is None else max(MX, mx)))
+        return merged
+
+    def _to_dataset(self, rows: list) -> Dataset:
+        return Dataset([ex.Read(lambda b=[rows]: b, 1)])
+
+    def count(self) -> Dataset:
+        rows = [{self._key: k, "count()": c}
+                for k, (c, *_rest) in sorted(self._combined(None).items())]
+        return self._to_dataset(rows)
+
+    def sum(self, on: str) -> Dataset:
+        rows = [{self._key: k, f"sum({on})": s}
+                for k, (_c, _vc, s, _mn, _mx) in sorted(self._combined(on).items())]
+        return self._to_dataset(rows)
+
+    def mean(self, on: str) -> Dataset:
+        rows = [{self._key: k, f"mean({on})": s / vc}
+                for k, (_c, vc, s, _mn, _mx) in sorted(self._combined(on).items())
+                if vc]
+        return self._to_dataset(rows)
+
+    def min(self, on: str) -> Dataset:
+        rows = [{self._key: k, f"min({on})": mn}
+                for k, (_c, _vc, _s, mn, _mx) in sorted(self._combined(on).items())]
+        return self._to_dataset(rows)
+
+    def max(self, on: str) -> Dataset:
+        rows = [{self._key: k, f"max({on})": mx}
+                for k, (_c, _vc, _s, _mn, mx) in sorted(self._combined(on).items())]
+        return self._to_dataset(rows)
 
 
 class DataIterator:

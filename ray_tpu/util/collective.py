@@ -55,6 +55,12 @@ class _Group:
 
     def __post_init__(self):
         self.written: list[tuple[int, str]] = []  # (seq, key) for lazy GC
+        # P2P counters, per peer and per direction — INDEPENDENT of the
+        # group seq: p2p matches only (src, dst, nth-message), so an
+        # asymmetric send/recv pattern must not desync the group's
+        # collective sequence (round-2 advisor finding).
+        self.p2p_sent: dict[int, int] = {}
+        self.p2p_rcvd: dict[int, int] = {}
 
 
 class GroupManager:
@@ -154,17 +160,27 @@ def barrier(group_name: str = "default"):
 
 
 def send(tensor, dst_rank: int, group_name: str = "default"):
-    """P2P send (reference collective.send); matched by (src, dst, seq)."""
+    """P2P send (reference collective.send); matched by the per-(src,dst)
+    message counter — deliberately NOT the group seq, so asymmetric p2p
+    patterns can't desync the group's collectives."""
     g = _manager.get(group_name)
-    seq = _next_seq(g)
-    _kv_put(f"col/{g.name}/{seq}/p2p/{g.rank}->{dst_rank}",
+    n = g.p2p_sent[dst_rank] = g.p2p_sent.get(dst_rank, 0) + 1
+    _kv_put(f"col/{g.name}/p2p/{g.rank}->{dst_rank}/{n}",
             pickle.dumps(tensor, protocol=5))
 
 
 def recv(src_rank: int, group_name: str = "default"):
     g = _manager.get(group_name)
-    seq = _next_seq(g)
-    blob = _kv_wait(f"col/{g.name}/{seq}/p2p/{src_rank}->{g.rank}")
+    n = g.p2p_rcvd[src_rank] = g.p2p_rcvd.get(src_rank, 0) + 1
+    key = f"col/{g.name}/p2p/{src_rank}->{g.rank}/{n}"
+    blob = _kv_wait(key)
+    # The receiver is this key's only reader: delete it immediately (the
+    # lazy two-rounds-back GC can't cover p2p — there is no rendezvous
+    # proving the peer has passed).
+    try:
+        _worker().kv("del", ns="collective", key=key)
+    except Exception:
+        pass
     return pickle.loads(blob)
 
 
