@@ -68,7 +68,14 @@ class Cluster:
         penv = dict(os.environ)
         penv.update(env or {})
         pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        penv["PYTHONPATH"] = pkg_root + os.pathsep + penv.get("PYTHONPATH", "")
+        # Forward the driver's sys.path (like HeadNode does for the local
+        # node): workers on this node must unpickle by-reference functions
+        # from any module the driver can import. Explicit PYTHONPATH stays
+        # first so it can shadow inherited driver paths.
+        driver_paths = [p for p in sys.path if p and os.path.exists(p)]
+        existing = penv.get("PYTHONPATH", "")
+        penv["PYTHONPATH"] = os.pathsep.join(
+            ([existing] if existing else []) + [pkg_root] + driver_paths)
         proc = subprocess.Popen(
             [
                 sys.executable,

@@ -328,6 +328,8 @@ def _equal_split(refs: list, n: int) -> list[list]:
         if len(parts) == 1 and parts[0][0] is not None:
             shards[parts[0][0]].append(ref)
             continue
+        if all(s is None for s, _t in parts):
+            continue  # block is entirely dropped remainder: no task needed
         # Cut in a remote task with one return per piece: payloads never
         # visit the driver (streaming_split feeds trainers with datasets
         # larger than driver memory).

@@ -167,8 +167,10 @@ class Dataset:
         return [DataIterator(s) for s in shards]
 
     def split(self, n: int) -> list["Dataset"]:
+        """General-purpose split: keeps EVERY row (unlike streaming_split's
+        training default, which equalizes by dropping the remainder)."""
         return [Dataset([ex.Read(lambda s=s: list(s._refs), len(s._refs))])
-                for s in self.streaming_split(n)]
+                for s in self.streaming_split(n, equal=False)]
 
     # ------------------------------------------------------------- writes
     def _write(self, path: str, fmt: str, ext: str) -> list[str]:
@@ -192,7 +194,9 @@ class Dataset:
         return self._write(path, "json", "json")
 
     # --------------------------------------------------------- aggregates
-    def _agg(self, on: Optional[str], np_fn, combine):
+    def _agg(self, on: Optional[str], op: str, combine):
+        """Per-block partial aggregates computed in remote tasks; only the
+        scalars come back to the driver."""
         refs = self._block_refs()
         if on is None:
             # Resolve the column ONCE from the schema so every block
@@ -203,30 +207,22 @@ class Dataset:
                 raise ValueError(
                     f"dataset has columns {cols}; pass on=<column> to aggregate")
             on = cols[0]
-        parts = []
-        for ref in refs:
-            batch = BlockAccessor.for_block(ray_tpu.get(ref, timeout=600)).to_batch()
-            if not batch:
-                continue
-            if on not in batch:
-                raise KeyError(f"block is missing aggregation column {on!r} "
-                               f"(has {list(batch)})")
-            v = batch[on]
-            if len(v):
-                parts.append(np_fn(v))
+        parts = [p for p in ray_tpu.get(
+            [_partial_agg.remote(r, on, op) for r in refs], timeout=600)
+            if p is not None]
         return combine(parts) if parts else None
 
     def sum(self, on: Optional[str] = None):
-        return self._agg(on, np.sum, sum)
+        return self._agg(on, "sum", sum)
 
     def min(self, on: Optional[str] = None):
-        return self._agg(on, np.min, min)
+        return self._agg(on, "min", min)
 
     def max(self, on: Optional[str] = None):
-        return self._agg(on, np.max, max)
+        return self._agg(on, "max", max)
 
     def mean(self, on: Optional[str] = None):
-        tot = self._agg(on, lambda v: (np.sum(v), len(v)),
+        tot = self._agg(on, "sum_count",
                         lambda ps: tuple(map(sum, zip(*ps))))
         if tot is None:
             return None
@@ -270,6 +266,29 @@ def _write_block(block, path: str, fmt: str) -> str:
 
 
 @ray_tpu.remote
+def _partial_agg(block, on: str, op: str):
+    """One block's partial aggregate (scalar or (sum, count) pair)."""
+    batch = BlockAccessor.for_block(block).to_batch()
+    if not batch:
+        return None
+    if on not in batch:
+        raise KeyError(f"block is missing aggregation column {on!r} "
+                       f"(has {list(batch)})")
+    v = batch[on]
+    if not len(v):
+        return None
+    if op == "sum":
+        return np.sum(v)
+    if op == "min":
+        return np.min(v)
+    if op == "max":
+        return np.max(v)
+    if op == "sum_count":
+        return (np.sum(v), len(v))
+    raise ValueError(f"unknown aggregate {op}")
+
+
+@ray_tpu.remote
 def _partial_group(block, key, on):
     """Map-side partial aggregation: key -> (rows, values, sum, min, max).
     `values` counts rows that actually carry the aggregation column — mean
@@ -300,6 +319,8 @@ class GroupedData:
     def __init__(self, ds: Dataset, key):
         self._ds = ds
         self._key = key
+        # Output rows need a string column name; a callable key has none.
+        self._key_col = key if isinstance(key, str) else "key"
 
     def _combined(self, on: Optional[str]) -> dict:
         parts = ray_tpu.get(
@@ -319,28 +340,28 @@ class GroupedData:
         return Dataset([ex.Read(lambda b=[rows]: b, 1)])
 
     def count(self) -> Dataset:
-        rows = [{self._key: k, "count()": c}
+        rows = [{self._key_col: k, "count()": c}
                 for k, (c, *_rest) in sorted(self._combined(None).items())]
         return self._to_dataset(rows)
 
     def sum(self, on: str) -> Dataset:
-        rows = [{self._key: k, f"sum({on})": s}
+        rows = [{self._key_col: k, f"sum({on})": s}
                 for k, (_c, _vc, s, _mn, _mx) in sorted(self._combined(on).items())]
         return self._to_dataset(rows)
 
     def mean(self, on: str) -> Dataset:
-        rows = [{self._key: k, f"mean({on})": s / vc}
+        rows = [{self._key_col: k, f"mean({on})": s / vc}
                 for k, (_c, vc, s, _mn, _mx) in sorted(self._combined(on).items())
                 if vc]
         return self._to_dataset(rows)
 
     def min(self, on: str) -> Dataset:
-        rows = [{self._key: k, f"min({on})": mn}
+        rows = [{self._key_col: k, f"min({on})": mn}
                 for k, (_c, _vc, _s, mn, _mx) in sorted(self._combined(on).items())]
         return self._to_dataset(rows)
 
     def max(self, on: str) -> Dataset:
-        rows = [{self._key: k, f"max({on})": mx}
+        rows = [{self._key_col: k, f"max({on})": mx}
                 for k, (_c, _vc, _s, _mn, mx) in sorted(self._combined(on).items())]
         return self._to_dataset(rows)
 

@@ -1,0 +1,43 @@
+"""ray_tpu.tune — distributed hyperparameter tuning.
+
+Parity target: reference python/ray/tune (Tuner/TuneConfig/ResultGrid,
+search spaces, ASHA/PBT schedulers). The hyperparameter axis of SURVEY
+§2.4's parallelism strategies: trials are actors scheduled like any other
+workload, so tuning composes with training/PGs/FT for free.
+"""
+
+from ray_tpu.tune._session import get_checkpoint, report
+from ray_tpu.tune.schedulers import (
+    ASHAScheduler,
+    FIFOScheduler,
+    PopulationBasedTraining,
+)
+from ray_tpu.tune.search import (
+    choice,
+    grid_search,
+    loguniform,
+    randint,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.trial import Trial
+from ray_tpu.tune.tuner import Result, ResultGrid, TuneConfig, Tuner
+
+__all__ = [
+    "ASHAScheduler",
+    "FIFOScheduler",
+    "PopulationBasedTraining",
+    "Result",
+    "ResultGrid",
+    "Trial",
+    "TuneConfig",
+    "Tuner",
+    "choice",
+    "get_checkpoint",
+    "grid_search",
+    "loguniform",
+    "randint",
+    "report",
+    "sample_from",
+    "uniform",
+]

@@ -1,0 +1,166 @@
+"""Trial schedulers: FIFO, ASHA, PBT.
+
+Parity target: reference python/ray/tune/schedulers/trial_scheduler.py
+(CONTINUE/STOP decisions), async_hyperband.py (AsyncHyperBandScheduler /
+ASHA — rungs at grace_period * rf^k, cutoff at the top 1/rf quantile), and
+pbt.py (PopulationBasedTraining — exploit top quantile + explore by
+perturbing hyperparams, pbt.py:405 _exploit).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class FIFOScheduler:
+    """Run every trial to completion (reference trial_scheduler.py:94)."""
+
+    def setup(self, metric: Optional[str], mode: Optional[str]):
+        self.metric, self.mode = metric, mode
+
+    def on_trial_result(self, controller, trial, result) -> str:
+        return CONTINUE
+
+    def on_trial_complete(self, controller, trial):
+        pass
+
+
+class ASHAScheduler(FIFOScheduler):
+    """Async successive halving (reference async_hyperband.py:343 _Bracket:
+    on_result records the metric at the highest rung <= t and stops the
+    trial if it falls below the rung's top-1/rf cutoff)."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 metric: Optional[str] = None, mode: Optional[str] = None,
+                 max_t: int = 100, grace_period: int = 1,
+                 reduction_factor: float = 4):
+        self.time_attr = time_attr
+        self.metric, self.mode = metric, mode
+        self.max_t = max_t
+        self.grace_period = grace_period
+        self.rf = reduction_factor
+        # rung milestones, ascending: grace, grace*rf, grace*rf^2, ... < max_t
+        self.rungs: list[float] = []
+        r = grace_period
+        while r < max_t:
+            self.rungs.append(r)
+            r *= reduction_factor
+        # rung value -> list of recorded metric values (in +is-better units)
+        self._recorded: dict[float, list[float]] = {r: [] for r in self.rungs}
+
+    def setup(self, metric, mode):
+        self.metric = self.metric or metric
+        self.mode = self.mode or mode or "max"
+
+    def _score(self, result) -> Optional[float]:
+        v = result.get(self.metric)
+        if v is None:
+            return None
+        return float(v) if self.mode == "max" else -float(v)
+
+    def on_trial_result(self, controller, trial, result) -> str:
+        t = result.get(self.time_attr, 0)
+        if t >= self.max_t:
+            return STOP
+        score = self._score(result)
+        if score is None:
+            return CONTINUE
+        decision = CONTINUE
+        seen = trial.sched_state.setdefault("asha_rungs", set())
+        for rung in self.rungs:
+            if t < rung or rung in seen:
+                continue
+            seen.add(rung)
+            recorded = self._recorded[rung]
+            recorded.append(score)
+            # Cutoff: top 1/rf of everything recorded at this rung so far.
+            if len(recorded) >= self.rf:
+                k = max(1, int(len(recorded) / self.rf))
+                cutoff = sorted(recorded, reverse=True)[k - 1]
+                if score < cutoff:
+                    decision = STOP
+        return decision
+
+
+class PopulationBasedTraining(FIFOScheduler):
+    """PBT: at each perturbation_interval, bottom-quantile trials clone the
+    config+checkpoint of a top-quantile trial and perturb hyperparams
+    (reference pbt.py _exploit:405 / _explore:88)."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 metric: Optional[str] = None, mode: Optional[str] = None,
+                 perturbation_interval: int = 5,
+                 hyperparam_mutations: Optional[dict] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 seed: Optional[int] = None):
+        self.time_attr = time_attr
+        self.metric, self.mode = metric, mode
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_prob = resample_probability
+        self._rng = random.Random(seed)
+
+    def setup(self, metric, mode):
+        self.metric = self.metric or metric
+        self.mode = self.mode or mode or "max"
+
+    def _score(self, trial) -> Optional[float]:
+        v = trial.metric(self.metric)
+        if v is None:
+            return None
+        return float(v) if self.mode == "max" else -float(v)
+
+    def _explore(self, config: dict) -> dict:
+        """Perturb mutated hyperparams *1.2/*0.8 or resample (reference
+        pbt.py _explore:88)."""
+        from ray_tpu.tune.search import Domain
+
+        out = dict(config)
+        for key, spec in self.mutations.items():
+            if self._rng.random() < self.resample_prob:
+                if isinstance(spec, Domain):
+                    out[key] = spec.sample(self._rng)
+                elif isinstance(spec, list):
+                    out[key] = self._rng.choice(spec)
+                elif callable(spec):
+                    out[key] = spec()
+            elif isinstance(out.get(key), (int, float)):
+                factor = 1.2 if self._rng.random() > 0.5 else 0.8
+                out[key] = type(out[key])(out[key] * factor)
+            elif isinstance(spec, list) and out.get(key) in spec:
+                # shift to a neighboring value
+                i = spec.index(out[key])
+                out[key] = spec[max(0, min(len(spec) - 1,
+                                           i + self._rng.choice((-1, 1))))]
+        return out
+
+    def on_trial_result(self, controller, trial, result) -> str:
+        t = result.get(self.time_attr, 0)
+        last = trial.sched_state.get("pbt_last_perturb", 0)
+        if t - last < self.interval:
+            return CONTINUE
+        trial.sched_state["pbt_last_perturb"] = t
+        peers = [tr for tr in controller.trials
+                 if self._score(tr) is not None]
+        if len(peers) < 2:
+            return CONTINUE
+        ranked = sorted(peers, key=self._score, reverse=True)
+        k = max(1, int(len(ranked) * self.quantile))
+        top, bottom = ranked[:k], ranked[-k:]
+        if trial not in bottom or trial in top:
+            return CONTINUE
+        donor = self._rng.choice(top)
+        if donor.checkpoint_path is None:
+            return CONTINUE
+        new_config = self._explore(donor.config)
+        controller.exploit(trial, donor, new_config)
+        return CONTINUE  # controller restarts the trial; no stop decision
+
+    def on_trial_complete(self, controller, trial):
+        pass

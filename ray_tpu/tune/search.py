@@ -1,0 +1,125 @@
+"""Search spaces + the basic variant generator.
+
+Parity target: reference python/ray/tune/search/sample.py (Domain/Float/
+Integer/Categorical, uniform:437, loguniform:480, choice:413, randint:500)
+and search/basic_variant.py (BasicVariantGenerator — grid cartesian product
+x num_samples random sampling).
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Callable, Optional
+
+
+class Domain:
+    def sample(self, rng: random.Random):
+        raise NotImplementedError
+
+
+class Float(Domain):
+    def __init__(self, lower: float, upper: float, log: bool = False):
+        if log and lower <= 0:
+            raise ValueError("loguniform requires lower > 0")
+        self.lower, self.upper, self.log = lower, upper, log
+
+    def sample(self, rng):
+        if self.log:
+            import math
+
+            return math.exp(rng.uniform(math.log(self.lower), math.log(self.upper)))
+        return rng.uniform(self.lower, self.upper)
+
+
+class Integer(Domain):
+    def __init__(self, lower: int, upper: int):
+        self.lower, self.upper = lower, upper
+
+    def sample(self, rng):
+        return rng.randrange(self.lower, self.upper)
+
+
+class Categorical(Domain):
+    def __init__(self, categories):
+        self.categories = list(categories)
+
+    def sample(self, rng):
+        return rng.choice(self.categories)
+
+
+class Function(Domain):
+    def __init__(self, fn: Callable):
+        self.fn = fn
+
+    def sample(self, rng):
+        return self.fn(None)
+
+
+def uniform(lower: float, upper: float) -> Float:
+    return Float(lower, upper)
+
+
+def loguniform(lower: float, upper: float) -> Float:
+    return Float(lower, upper, log=True)
+
+
+def randint(lower: int, upper: int) -> Integer:
+    return Integer(lower, upper)
+
+
+def choice(categories) -> Categorical:
+    return Categorical(categories)
+
+
+def sample_from(fn: Callable) -> Function:
+    return Function(fn)
+
+
+def grid_search(values: list) -> dict:
+    return {"grid_search": list(values)}
+
+
+def _is_grid(v) -> bool:
+    return isinstance(v, dict) and set(v.keys()) == {"grid_search"}
+
+
+def _walk(space: dict, path=()):
+    """Yield (path, value) leaves of a nested param space dict."""
+    for k, v in space.items():
+        if isinstance(v, dict) and not _is_grid(v):
+            yield from _walk(v, path + (k,))
+        else:
+            yield path + (k,), v
+
+
+def _set_path(d: dict, path: tuple, value):
+    for k in path[:-1]:
+        d = d.setdefault(k, {})
+    d[path[-1]] = value
+
+
+class BasicVariantGenerator:
+    """Grid cartesian product x num_samples; non-grid Domains resampled per
+    variant (reference basic_variant.py)."""
+
+    def __init__(self, seed: Optional[int] = None):
+        self._rng = random.Random(seed)
+
+    def generate(self, param_space: dict, num_samples: int) -> list[dict]:
+        leaves = list(_walk(param_space or {}))
+        grid_axes = [(p, v["grid_search"]) for p, v in leaves if _is_grid(v)]
+        combos = list(itertools.product(*[vals for _p, vals in grid_axes])) or [()]
+        configs = []
+        for _ in range(max(1, num_samples)):
+            for combo in combos:
+                cfg: dict = {}
+                for (p, v) in leaves:
+                    if _is_grid(v):
+                        continue
+                    _set_path(cfg, p, v.sample(self._rng)
+                              if isinstance(v, Domain) else v)
+                for (p, _vals), val in zip(grid_axes, combo):
+                    _set_path(cfg, p, val)
+                configs.append(cfg)
+        return configs

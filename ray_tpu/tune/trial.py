@@ -1,0 +1,40 @@
+"""Trial bookkeeping.
+
+Parity target: reference python/ray/tune/experiment/trial.py (Trial status
+machine PENDING/RUNNING/PAUSED/TERMINATED/ERROR).
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Optional
+
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+TERMINATED = "TERMINATED"
+ERROR = "ERROR"
+
+
+class Trial:
+    def __init__(self, config: dict, trial_dir: str):
+        self.trial_id = uuid.uuid4().hex[:8]
+        self.config = config
+        self.trial_dir = trial_dir
+        self.status = PENDING
+        self.runner = None  # actor handle while RUNNING
+        self.last_result: Optional[dict] = None
+        self.results: list[dict] = []
+        self.checkpoint_path: Optional[str] = None
+        self.restore_from: Optional[str] = None  # set by PBT exploit
+        self.error: Optional[str] = None
+        self.iteration = 0
+        # scheduler scratch (e.g. ASHA rungs this trial has been recorded at)
+        self.sched_state: dict[str, Any] = {}
+
+    def metric(self, name: str, default=None):
+        if self.last_result is None:
+            return default
+        return self.last_result.get(name, default)
+
+    def __repr__(self):
+        return f"Trial({self.trial_id}, {self.status}, it={self.iteration})"

@@ -1,0 +1,316 @@
+"""Tuner + the trial event-loop controller.
+
+Parity target: reference python/ray/tune/tuner.py:43 (Tuner, fit:312),
+tune/execution/tune_controller.py:68 (TuneController; step loop :666 —
+start trials, collect actor futures, route results to scheduler/searcher,
+stop/perturb/restart), tune/result_grid.py (ResultGrid).
+
+Execution model: one actor per live trial hosting the trainable
+(ray_tpu/tune/_runner.py); the controller's loop multiplexes
+`next_result()` futures over ray_tpu.wait — the same shape as the
+reference's _actor_to_trial future bookkeeping, minus the placement-group
+indirection (trial resources ride the actor's own resource request).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import ray_tpu
+from ray_tpu.train import JaxTrainer, RunConfig
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.tune import schedulers as sched_mod
+from ray_tpu.tune._runner import TrialRunner
+from ray_tpu.tune.search import BasicVariantGenerator
+from ray_tpu.tune.trial import ERROR, PENDING, RUNNING, TERMINATED, Trial
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class TuneConfig:
+    """reference tune/tune_config.py."""
+
+    metric: Optional[str] = None
+    mode: Optional[str] = None  # "min" | "max"
+    num_samples: int = 1
+    scheduler: Optional[Any] = None
+    max_concurrent_trials: Optional[int] = None
+    seed: Optional[int] = None
+    resources_per_trial: Optional[dict] = None
+
+
+@dataclass
+class Result:
+    """reference air/result.py Result."""
+
+    metrics: Optional[dict]
+    config: dict
+    checkpoint: Optional[Checkpoint]
+    error: Optional[str]
+    path: str
+    trial_id: str
+
+    @property
+    def best_checkpoint(self):
+        return self.checkpoint
+
+
+class ResultGrid:
+    """reference tune/result_grid.py."""
+
+    def __init__(self, results: list[Result], metric: Optional[str],
+                 mode: Optional[str]):
+        self._results = results
+        self._metric, self._mode = metric, mode
+
+    def __len__(self):
+        return len(self._results)
+
+    def __getitem__(self, i) -> Result:
+        return self._results[i]
+
+    def __iter__(self):
+        return iter(self._results)
+
+    @property
+    def num_errors(self) -> int:
+        return sum(1 for r in self._results if r.error is not None)
+
+    def get_best_result(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode or "max"
+        if metric is None:
+            raise ValueError("pass metric= (or set TuneConfig.metric)")
+        ok = [r for r in self._results
+              if r.metrics is not None and metric in r.metrics]
+        if not ok:
+            raise RuntimeError("no trial reported metric " + metric)
+        return (max if mode == "max" else min)(
+            ok, key=lambda r: r.metrics[metric])
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        rows = []
+        for r in self._results:
+            row = dict(r.metrics or {})
+            row["trial_id"] = r.trial_id
+            for k, v in r.config.items():
+                row[f"config/{k}"] = v
+            rows.append(row)
+        return pd.DataFrame(rows)
+
+
+class TuneController:
+    """Event loop over trial actors (reference tune_controller.py:68)."""
+
+    WAIT_S = 3.0
+    RESULT_TIMEOUT_S = 2.0
+
+    def __init__(self, trainable: Callable, configs: list[dict],
+                 tune_config: TuneConfig, run_config: RunConfig,
+                 exp_dir: str):
+        self.trainable = trainable
+        self.tc = tune_config
+        self.rc = run_config
+        self.exp_dir = exp_dir
+        self.trials = [Trial(cfg, "") for cfg in configs]
+        for t in self.trials:
+            t.trial_dir = os.path.join(exp_dir, f"trial_{t.trial_id}")
+        self.scheduler = tune_config.scheduler or sched_mod.FIFOScheduler()
+        self.scheduler.setup(tune_config.metric, tune_config.mode)
+        self._futures: dict = {}  # next_result future -> (trial, runner)
+        self._restarts: dict[str, int] = {}  # trial_id -> failure count
+
+    # ----------------------------------------------------------- lifecycle
+    def _remote_runner(self):
+        res = dict(self.tc.resources_per_trial or {"CPU": 1})
+        num_cpus = res.pop("CPU", 1)
+        return ray_tpu.remote(num_cpus=num_cpus, resources=res or None,
+                              max_concurrency=2)(TrialRunner)
+
+    def _start(self, trial: Trial):
+        runner_cls = self._remote_runner()
+        trial.runner = runner_cls.remote(
+            self.trainable, trial.config, trial.trial_id, trial.trial_dir,
+            trial.restore_from)
+        trial.runner.start.remote()
+        trial.status = RUNNING
+        self._ask(trial)
+
+    def _ask(self, trial: Trial):
+        fut = trial.runner.next_result.remote(self.RESULT_TIMEOUT_S)
+        self._futures[fut] = (trial, trial.runner)
+
+    def _kill(self, trial: Trial):
+        runner, trial.runner = trial.runner, None
+        if runner is not None:
+            try:
+                runner.stop.remote()
+                ray_tpu.kill(runner)
+            except Exception:
+                pass
+
+    def _stop_trial(self, trial: Trial, status: str = TERMINATED,
+                    error: Optional[str] = None):
+        trial.status = status
+        trial.error = error
+        self._kill(trial)
+        self.scheduler.on_trial_complete(self, trial)
+
+    def exploit(self, trial: Trial, donor: Trial, new_config: dict):
+        """PBT: restart `trial` from donor's checkpoint with a perturbed
+        config (reference pbt.py _exploit:405)."""
+        logger.info("tune: trial %s exploits %s", trial.trial_id, donor.trial_id)
+        self._kill(trial)
+        trial.config = new_config
+        trial.restore_from = donor.checkpoint_path
+        self._start(trial)
+
+    # ---------------------------------------------------------- event loop
+    def run(self) -> list[Trial]:
+        pending = deque(t for t in self.trials)
+        limit = self.tc.max_concurrent_trials or len(self.trials)
+        while True:
+            running = [t for t in self.trials if t.status == RUNNING]
+            while pending and len(running) < limit:
+                t = pending.popleft()
+                self._start(t)
+                running.append(t)
+            if not running and not pending:
+                break
+            if not self._futures:
+                time.sleep(0.05)
+                continue
+            done, _ = ray_tpu.wait(list(self._futures), num_returns=1,
+                                   timeout=self.WAIT_S)
+            for fut in done:
+                trial, runner = self._futures.pop(fut)
+                if trial.runner is not runner:
+                    continue  # stale future from a pre-exploit incarnation
+                try:
+                    event = ray_tpu.get(fut, timeout=5)
+                except Exception as e:  # actor died (or was killed)
+                    if trial.status == RUNNING:
+                        self._on_trial_error(trial, repr(e))
+                    continue
+                self._on_event(trial, event)
+        return self.trials
+
+    def _on_event(self, trial: Trial, event):
+        if event is None:  # poll timeout: keep listening
+            self._ask(trial)
+            return
+        kind, payload, ckpt_path = event
+        if kind in ("report", "final"):
+            metrics = dict(payload)
+            trial.last_result = metrics
+            trial.results.append(metrics)
+            trial.iteration = metrics.get("training_iteration", trial.iteration)
+            if ckpt_path:
+                trial.checkpoint_path = ckpt_path
+            if kind == "final":
+                self._stop_trial(trial)
+                return
+            if self._hit_stop_criteria(metrics):
+                self._stop_trial(trial)
+                return
+            decision = self.scheduler.on_trial_result(self, trial, metrics)
+            if trial.runner is None:
+                return  # scheduler restarted/killed it (PBT exploit)
+            if decision == sched_mod.STOP:
+                self._stop_trial(trial)
+            else:
+                self._ask(trial)
+        elif kind == "done":
+            if trial.status == RUNNING:
+                self._stop_trial(trial)
+        elif kind == "error":
+            self._on_trial_error(trial, payload)
+
+    def _on_trial_error(self, trial: Trial, err: str):
+        n = self._restarts.get(trial.trial_id, 0)
+        maxf = self.rc.failure_config.max_failures
+        if maxf == -1 or n < maxf:
+            self._restarts[trial.trial_id] = n + 1
+            logger.warning("tune: trial %s failed (%d/%s), restarting",
+                           trial.trial_id, n + 1, maxf)
+            self._kill(trial)
+            trial.restore_from = trial.checkpoint_path
+            self._start(trial)
+        else:
+            logger.error("tune: trial %s failed:\n%s", trial.trial_id, err)
+            self._stop_trial(trial, status=ERROR, error=err)
+
+    def _hit_stop_criteria(self, metrics: dict) -> bool:
+        stop = getattr(self.rc, "stop", None)
+        if not stop:
+            return False
+        return any(metrics.get(k) is not None and metrics[k] >= v
+                   for k, v in stop.items())
+
+
+def _trainable_from_trainer(trainer: JaxTrainer) -> Callable:
+    """Run a JaxTrainer as a trial (reference base_trainer.py:651 wraps
+    every Trainer into a Tune trial; param_space["train_loop_config"]
+    overrides merge into the trainer's config)."""
+
+    def _fit_trial(config):
+        import dataclasses
+
+        from ray_tpu.tune import _session
+
+        cfg = dict(trainer._config or {})
+        cfg.update(config.get("train_loop_config", config))
+        sess = _session.get_session()
+        run_cfg = dataclasses.replace(
+            trainer._run_config, storage_path=os.path.join(
+                sess.trial_dir, "train"), name=None)
+        t = JaxTrainer(trainer._train_fn, train_loop_config=cfg,
+                       scaling_config=trainer._scaling,
+                       run_config=run_cfg, datasets=trainer._datasets)
+        res = t.fit()
+        sess.report(dict(res.metrics or {}),
+                    checkpoint=res.checkpoint)
+
+    return _fit_trial
+
+
+class Tuner:
+    """reference tune/tuner.py:43."""
+
+    def __init__(self, trainable, *, param_space: Optional[dict] = None,
+                 tune_config: Optional[TuneConfig] = None,
+                 run_config: Optional[RunConfig] = None):
+        if isinstance(trainable, JaxTrainer):
+            trainable = _trainable_from_trainer(trainable)
+        self._trainable = trainable
+        self._param_space = param_space or {}
+        self._tune_config = tune_config or TuneConfig()
+        self._run_config = run_config or RunConfig()
+
+    def fit(self) -> ResultGrid:
+        tc = self._tune_config
+        name = self._run_config.name or f"tune_{int(time.time())}"
+        exp_dir = os.path.join(self._run_config.resolved_storage(), name)
+        os.makedirs(exp_dir, exist_ok=True)
+        configs = BasicVariantGenerator(tc.seed).generate(
+            self._param_space, tc.num_samples)
+        controller = TuneController(self._trainable, configs, tc,
+                                    self._run_config, exp_dir)
+        trials = controller.run()
+        results = [
+            Result(metrics=t.last_result, config=t.config,
+                   checkpoint=Checkpoint(t.checkpoint_path)
+                   if t.checkpoint_path else None,
+                   error=t.error, path=t.trial_dir, trial_id=t.trial_id)
+            for t in trials
+        ]
+        return ResultGrid(results, tc.metric, tc.mode)
