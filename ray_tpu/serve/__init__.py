@@ -1,0 +1,287 @@
+"""ray_tpu.serve — scalable model serving on the cluster runtime.
+
+Parity target: reference python/ray/serve (deployment decorator + .bind
+application graphs, serve.run, DeploymentHandle composition, @serve.batch,
+autoscaling, HTTP ingress). The serving half of the TPU-era value
+proposition: replicas are async actors whose event loops interleave
+requests, the controller reconciles declared state, and routing uses
+power-of-two-choices over long-polled membership.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import functools
+import hashlib
+import pickle
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import ray_tpu
+from ray_tpu.serve._private.controller import (
+    CONTROLLER_NAME,
+    PROXY_NAME,
+    ServeController,
+)
+from ray_tpu.serve._private.replica import Request
+from ray_tpu.serve._private.router import (
+    DeploymentHandle,
+    DeploymentResponse,
+    reset_routers,
+)
+
+__all__ = [
+    "Application",
+    "Deployment",
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "Request",
+    "batch",
+    "delete",
+    "deployment",
+    "get_app_handle",
+    "get_deployment_handle",
+    "run",
+    "shutdown",
+    "status",
+]
+
+
+@dataclass
+class Application:
+    """A bound deployment (+ its bound argument subgraph) — reference
+    serve built-application graphs (Deployment.bind)."""
+
+    deployment: "Deployment"
+    args: tuple = ()
+    kwargs: dict = field(default_factory=dict)
+
+
+class Deployment:
+    def __init__(self, func_or_class, name: str, num_replicas=1,
+                 ray_actor_options: Optional[dict] = None,
+                 max_ongoing_requests: int = 16,
+                 autoscaling_config: Optional[dict] = None,
+                 version: Optional[str] = None):
+        self._func_or_class = func_or_class
+        self.name = name
+        self.num_replicas = num_replicas
+        self.ray_actor_options = ray_actor_options
+        self.max_ongoing_requests = max_ongoing_requests
+        self.autoscaling_config = autoscaling_config
+        self.version = version
+
+    def options(self, **overrides) -> "Deployment":
+        cfg = dict(
+            name=self.name, num_replicas=self.num_replicas,
+            ray_actor_options=self.ray_actor_options,
+            max_ongoing_requests=self.max_ongoing_requests,
+            autoscaling_config=self.autoscaling_config, version=self.version)
+        cfg.update(overrides)
+        return Deployment(self._func_or_class, **cfg)
+
+    def bind(self, *args, **kwargs) -> Application:
+        return Application(self, args, kwargs)
+
+    def _spec(self, route_prefix: Optional[str], args: tuple,
+              kwargs: dict) -> dict:
+        import cloudpickle
+
+        version = self.version or hashlib.sha1(
+            cloudpickle.dumps(self._func_or_class)).hexdigest()[:12]
+        num_replicas = self.num_replicas
+        autoscaling = self.autoscaling_config
+        if num_replicas == "auto" and autoscaling is None:
+            autoscaling = {"min_replicas": 1, "max_replicas": 4,
+                           "target_ongoing_requests": 2}
+        return {
+            "name": self.name,
+            "callable": self._func_or_class,
+            "init_args": args,
+            "init_kwargs": kwargs,
+            "num_replicas": 1 if num_replicas == "auto" else num_replicas,
+            "autoscaling_config": autoscaling,
+            "ray_actor_options": self.ray_actor_options,
+            "max_ongoing_requests": self.max_ongoing_requests,
+            "route_prefix": route_prefix,
+            "version": version,
+        }
+
+
+def deployment(_func_or_class=None, *, name: Optional[str] = None,
+               num_replicas=1, ray_actor_options: Optional[dict] = None,
+               max_ongoing_requests: int = 16,
+               autoscaling_config: Optional[dict] = None,
+               version: Optional[str] = None):
+    """@serve.deployment (reference api.py:deployment)."""
+
+    def wrap(fc):
+        return Deployment(fc, name or fc.__name__, num_replicas,
+                          ray_actor_options, max_ongoing_requests,
+                          autoscaling_config, version)
+
+    if _func_or_class is not None:
+        return wrap(_func_or_class)
+    return wrap
+
+
+# ------------------------------------------------------------------ control
+def _get_or_create_controller():
+    wrapped = ray_tpu.remote(num_cpus=0, max_concurrency=64)(ServeController)
+    return wrapped.options(name=CONTROLLER_NAME, lifetime="detached",
+                           get_if_exists=True).remote()
+
+
+def _deploy_app(app: Application, controller, route_prefix: Optional[str],
+                seen: dict) -> str:
+    """Deploy `app` and (recursively) every Application bound into its
+    args, replacing them with DeploymentHandles (model composition —
+    reference build_app / handle injection)."""
+
+    def resolve(v):
+        if isinstance(v, Application):
+            dep_name = _deploy_app(v, controller, None, seen)
+            return DeploymentHandle(dep_name, CONTROLLER_NAME)
+        return v
+
+    if id(app) in seen:
+        return seen[id(app)]
+    args = tuple(resolve(a) for a in app.args)
+    kwargs = {k: resolve(v) for k, v in app.kwargs.items()}
+    spec = app.deployment._spec(route_prefix, args, kwargs)
+    ray_tpu.get(controller.deploy.remote(spec), timeout=30)
+    seen[id(app)] = spec["name"]
+    return spec["name"]
+
+
+def run(target: Application, *, route_prefix: str = "/",
+        host: str = "127.0.0.1", port: int = 8000,
+        _blocking: bool = True, timeout_s: float = 60.0) -> DeploymentHandle:
+    """Deploy an application and start the HTTP ingress (reference
+    serve/api.py:run)."""
+    if not isinstance(target, Application):
+        raise TypeError("serve.run expects Deployment.bind(...)")
+    controller = _get_or_create_controller()
+    ingress = _deploy_app(target, controller, route_prefix, {})
+    # HTTP proxy (one; reference runs one per node).
+    from ray_tpu.serve._private.proxy import Proxy
+
+    proxy_cls = ray_tpu.remote(num_cpus=0, max_concurrency=64)(Proxy)
+    proxy = proxy_cls.options(name=PROXY_NAME, lifetime="detached",
+                              get_if_exists=True).remote(
+        CONTROLLER_NAME, host, port)
+    ray_tpu.get(proxy.ready.remote(), timeout=30)
+    if _blocking:
+        deadline = time.monotonic() + timeout_s
+        st: dict = {}
+        while time.monotonic() < deadline:
+            st = ray_tpu.get(controller.status.remote(), timeout=10)
+            if all(d["status"] == "RUNNING" for d in st.values()):
+                break
+            time.sleep(0.1)
+        else:
+            raise TimeoutError(f"deployments not ready after {timeout_s}s: {st}")
+    return DeploymentHandle(ingress, CONTROLLER_NAME)
+
+
+def status() -> dict:
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    return ray_tpu.get(controller.status.remote(), timeout=10)
+
+
+def get_deployment_handle(deployment_name: str, app_name: str = "") -> DeploymentHandle:
+    return DeploymentHandle(deployment_name, CONTROLLER_NAME)
+
+
+get_app_handle = get_deployment_handle
+
+
+def delete(name: str):
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    ray_tpu.get(controller.delete.remote(name), timeout=30)
+
+
+def shutdown():
+    """Tear down all deployments, the proxy, and the controller."""
+    try:
+        controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    except Exception:
+        reset_routers()
+        return
+    try:
+        ray_tpu.get(controller.shutdown_all.remote(), timeout=30)
+    except Exception:
+        pass
+    for name in (PROXY_NAME, CONTROLLER_NAME):
+        try:
+            ray_tpu.kill(ray_tpu.get_actor(name))
+        except Exception:
+            pass
+    reset_routers()
+
+
+# ------------------------------------------------------------------- batch
+def batch(_func=None, *, max_batch_size: int = 8,
+          batch_wait_timeout_s: float = 0.01):
+    """@serve.batch (reference serve/batching.py): concurrent calls to the
+    wrapped async method are buffered and delivered as ONE call with a list
+    argument; each caller gets its element of the returned list. The
+    batch-inference pattern for the MXU: many small requests fuse into one
+    large matmul-shaped call."""
+
+    def wrap(func):
+        state_attr = f"__serve_batch_{func.__name__}"
+
+        @functools.wraps(func)
+        async def wrapper(self, item):
+            # Everything here runs on ONE event loop (the replica's), so the
+            # queue/drainer handoff needs no locks: a coroutine can only be
+            # interleaved at its awaits.
+            st = getattr(self, state_attr, None)
+            if st is None:
+                st = {"queue": [], "wake": asyncio.Event(), "drainer": None}
+                setattr(self, state_attr, st)
+            fut = asyncio.get_event_loop().create_future()
+            st["queue"].append((item, fut))
+            if len(st["queue"]) >= max_batch_size:
+                st["wake"].set()
+            if st["drainer"] is None or st["drainer"].done():
+                st["drainer"] = asyncio.ensure_future(_drain(self, st))
+            return await fut
+
+        async def _drain(self_obj, st):
+            """Lives while there is work; flushes one batch per round. A
+            batch in flight is never cancelled, and items arriving during a
+            flush are picked up by the next round (the while-check and the
+            task's completion are atomic w.r.t. the loop, so wrapper's
+            done()-check can't miss work)."""
+            while st["queue"]:
+                st["wake"] = asyncio.Event()
+                if len(st["queue"]) < max_batch_size:
+                    try:
+                        await asyncio.wait_for(st["wake"].wait(),
+                                               timeout=batch_wait_timeout_s)
+                    except asyncio.TimeoutError:
+                        pass
+                batch = st["queue"][:max_batch_size]
+                st["queue"] = st["queue"][max_batch_size:]
+                try:
+                    outs = await func(self_obj, [b[0] for b in batch])
+                    if len(outs) != len(batch):
+                        raise ValueError(
+                            f"@serve.batch function returned {len(outs)} "
+                            f"results for {len(batch)} inputs")
+                    for (_i, fut), out in zip(batch, outs):
+                        if not fut.done():
+                            fut.set_result(out)
+                except Exception as e:
+                    for _i, fut in batch:
+                        if not fut.done():
+                            fut.set_exception(e)
+
+        return wrapper
+
+    if _func is not None:
+        return wrap(_func)
+    return wrap

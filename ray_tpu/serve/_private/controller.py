@@ -1,0 +1,283 @@
+"""Serve controller: reconciles declared deployments into replica actors.
+
+Parity target: reference python/ray/serve/_private/controller.py:86
+(ServeController.run_control_loop) + deployment_state.py:1248,2343 (the
+reconciler: scale up/down, rolling updates, health checks) +
+long_poll.py (LongPollHost — version-gated config push to routers/proxies)
++ autoscaling_policy.py (ongoing-requests-based replica count).
+
+One async actor; the reconcile loop runs as a background task on its event
+loop. Routing state is versioned; get_routing()/route_table() long-poll
+until the version advances (or time out), which is how routers and proxies
+learn about replica membership changes without polling hot loops.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import math
+import time
+import uuid
+from typing import Any, Optional
+
+import ray_tpu
+
+logger = logging.getLogger(__name__)
+
+CONTROLLER_NAME = "_serve_controller"
+PROXY_NAME = "_serve_proxy"
+RECONCILE_INTERVAL_S = 0.2
+AUTOSCALE_INTERVAL_S = 0.5
+DOWNSCALE_PATIENCE = 4  # consecutive intervals below target before shrink
+
+
+class _DeploymentState:
+    def __init__(self, spec: dict):
+        self.spec = spec
+        self.replicas: dict[str, dict] = {}  # rid -> {handle, ready}
+        self.stopping: list = []  # handles being drained
+        self.low_ticks = 0  # autoscale downscale patience
+        self.target = self._initial_target()
+
+    def _initial_target(self) -> int:
+        n = self.spec.get("num_replicas", 1)
+        if self.spec.get("autoscaling_config"):
+            return int(self.spec["autoscaling_config"].get("min_replicas", 1))
+        return int(n)
+
+    def ready_replicas(self) -> list[tuple[str, Any]]:
+        return [(rid, r["handle"]) for rid, r in self.replicas.items()
+                if r["ready"]]
+
+
+class ServeController:
+    def __init__(self):
+        self.deployments: dict[str, _DeploymentState] = {}
+        self.routes: dict[str, str] = {}  # route_prefix -> deployment name
+        self.version = 0
+        self._version_event: Optional[asyncio.Event] = None
+        self._loop_task = None
+        self._shutdown = False
+        # rolling updates: deployment -> old-generation replicas still
+        # serving until the new generation is ready
+        self._retire_after_ready: dict[str, dict] = {}
+
+    # ------------------------------------------------------------ plumbing
+    def _ensure_loop(self):
+        if self._version_event is None:
+            self._version_event = asyncio.Event()
+        if self._loop_task is None:
+            self._loop_task = asyncio.ensure_future(self._control_loop())
+
+    def _bump(self):
+        self.version += 1
+        if self._version_event is not None:
+            self._version_event.set()
+            self._version_event = asyncio.Event()
+
+    async def _wait_version(self, known: int, timeout: float):
+        deadline = time.monotonic() + timeout
+        while self.version == known and not self._shutdown:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                return
+            self._ensure_loop()
+            try:
+                await asyncio.wait_for(asyncio.shield(self._version_event.wait()),
+                                       timeout=min(left, 1.0))
+            except asyncio.TimeoutError:
+                pass
+
+    # ------------------------------------------------------------- public
+    async def deploy(self, spec: dict) -> None:
+        """Register (or update) a deployment; reconciliation is async —
+        poll status() for readiness (reference deploy path: client.deploy ->
+        wait_for_deployment_healthy)."""
+        self._ensure_loop()
+        name = spec["name"]
+        cur = self.deployments.get(name)
+        if cur is not None and cur.spec.get("version") == spec.get("version"):
+            # config-only update (e.g. num_replicas): keep replicas
+            cur.spec = spec
+            if not spec.get("autoscaling_config"):
+                cur.target = int(spec.get("num_replicas", 1))
+        else:
+            st = _DeploymentState(spec)
+            if cur is not None:
+                # rolling update: keep old replicas serving; they are
+                # retired once the new generation is ready. If an even
+                # older generation is still parked here (two rapid
+                # deploys), stop it now — nothing routes to it anymore.
+                stale = self._retire_after_ready.pop(name, None)
+                if stale:
+                    for r in stale.values():
+                        asyncio.ensure_future(self._stop_replica(r["handle"]))
+                self._retire_after_ready[name] = cur.replicas
+            self.deployments[name] = st
+        prefix = spec.get("route_prefix")
+        if prefix:
+            self.routes = {p: d for p, d in self.routes.items() if d != name}
+            self.routes[prefix] = name
+        self._bump()
+
+    async def get_routing(self, deployment: str, known_version: int = -1,
+                          timeout: float = 10.0) -> dict:
+        if known_version == self.version:
+            await self._wait_version(known_version, timeout)
+        st = self.deployments.get(deployment)
+        reps = st.ready_replicas() if st else []
+        # During a rolling update the outgoing generation keeps serving
+        # until the new one is ready (no dropped requests).
+        retire = self._retire_after_ready.get(deployment)
+        if retire and not reps:
+            reps = [(rid, r["handle"]) for rid, r in retire.items() if r["ready"]]
+        return {"version": self.version, "replicas": reps}
+
+    async def route_table(self, known_version: int = -1,
+                          timeout: float = 10.0) -> dict:
+        if known_version == self.version:
+            await self._wait_version(known_version, timeout)
+        return {"version": self.version, "routes": dict(self.routes)}
+
+    async def status(self) -> dict:
+        out = {}
+        for name, st in self.deployments.items():
+            ready = len(st.ready_replicas())
+            out[name] = {
+                "target": st.target,
+                "ready": ready,
+                "status": "RUNNING" if ready >= max(1, st.target) else "UPDATING",
+            }
+        return out
+
+    async def delete(self, name: str):
+        st = self.deployments.pop(name, None)
+        self.routes = {p: d for p, d in self.routes.items() if d != name}
+        if st is not None:
+            for rid, r in st.replicas.items():
+                asyncio.ensure_future(self._stop_replica(r["handle"]))
+        retired = self._retire_after_ready.pop(name, None)
+        if retired:
+            for r in retired.values():
+                asyncio.ensure_future(self._stop_replica(r["handle"]))
+        self._bump()
+
+    async def shutdown_all(self):
+        self._shutdown = True
+        for name in list(self.deployments):
+            await self.delete(name)
+        return True
+
+    # ----------------------------------------------------------- reconcile
+    async def _control_loop(self):
+        last_autoscale = 0.0
+        while not self._shutdown:
+            try:
+                now = time.monotonic()
+                for name, st in list(self.deployments.items()):
+                    await self._reconcile(name, st)
+                if now - last_autoscale >= AUTOSCALE_INTERVAL_S:
+                    last_autoscale = now
+                    for name, st in list(self.deployments.items()):
+                        if st.spec.get("autoscaling_config"):
+                            await self._autoscale(name, st)
+            except Exception:
+                logger.exception("serve controller reconcile error")
+            await asyncio.sleep(RECONCILE_INTERVAL_S)
+
+    async def _reconcile(self, name: str, st: _DeploymentState):
+        # Scale up.
+        while len(st.replicas) < st.target:
+            self._start_replica(name, st)
+        # Promote replicas whose ready() resolved.
+        for rid, r in list(st.replicas.items()):
+            if not r["ready"] and r["ready_ref"] is not None:
+                done, _ = ray_tpu.wait([r["ready_ref"]], num_returns=1, timeout=0)
+                if done:
+                    try:
+                        ray_tpu.get(done[0], timeout=1)
+                        r["ready"] = True
+                        r["ready_ref"] = None
+                        self._bump()
+                    except Exception as e:
+                        logger.warning("serve: replica %s failed to start: %r",
+                                       rid, e)
+                        st.replicas.pop(rid, None)
+        # Finish a rolling update: retire the old generation once the new
+        # one is fully ready.
+        old = self._retire_after_ready.get(name)
+        if old and len(st.ready_replicas()) >= max(1, st.target):
+            self._retire_after_ready.pop(name, None)
+            self._bump()  # routers switch to the new generation NOW
+            for rid, r in old.items():
+                asyncio.ensure_future(self._stop_replica(r["handle"]))
+        # Scale down (newest first, like the reference's replica selection).
+        while len(st.replicas) > st.target:
+            rid = next(reversed(st.replicas))
+            r = st.replicas.pop(rid)
+            self._bump()
+            asyncio.ensure_future(self._stop_replica(r["handle"]))
+
+    def _start_replica(self, name: str, st: _DeploymentState):
+        spec = st.spec
+        rid = f"{name}#{uuid.uuid4().hex[:6]}"
+        opts = dict(spec.get("ray_actor_options") or {})
+        opts.setdefault("num_cpus", 1)
+        opts["max_concurrency"] = int(spec.get("max_ongoing_requests", 16))
+        from ray_tpu.serve._private.replica import Replica
+
+        actor_cls = ray_tpu.remote(**opts)(Replica)
+        handle = actor_cls.remote(name, rid, spec["callable"],
+                                  tuple(spec.get("init_args") or ()),
+                                  dict(spec.get("init_kwargs") or {}))
+        st.replicas[rid] = {"handle": handle, "ready": False,
+                            "ready_ref": handle.ready.remote()}
+
+    async def _stop_replica(self, handle):
+        try:
+            ref = handle.drain.remote(5.0)
+            await self._async_get(ref, timeout=8)
+        except Exception:
+            pass
+        try:
+            ray_tpu.kill(handle)
+        except Exception:
+            pass
+
+    async def _autoscale(self, name: str, st: _DeploymentState):
+        cfg = st.spec["autoscaling_config"]
+        lo = int(cfg.get("min_replicas", 1))
+        hi = int(cfg.get("max_replicas", max(lo, 1)))
+        target_ongoing = float(cfg.get("target_ongoing_requests", 2))
+        reps = st.ready_replicas()
+        if not reps:
+            return
+        total = 0
+        for _rid, h in reps:
+            try:
+                s = await self._async_get(h.stats.remote(), timeout=2)
+                total += s["ongoing"]
+            except Exception:
+                pass
+        desired = max(lo, min(hi, math.ceil(total / target_ongoing) or lo))
+        if desired > st.target:
+            logger.info("serve: autoscale %s %d -> %d (ongoing=%d)",
+                        name, st.target, desired, total)
+            st.target = desired
+            st.low_ticks = 0
+        elif desired < st.target:
+            st.low_ticks += 1
+            if st.low_ticks >= DOWNSCALE_PATIENCE:
+                logger.info("serve: autoscale %s %d -> %d (ongoing=%d)",
+                            name, st.target, desired, total)
+                st.target = desired
+                st.low_ticks = 0
+        else:
+            st.low_ticks = 0
+
+    @staticmethod
+    async def _async_get(ref, timeout: float):
+        """Await an ObjectRef without blocking the actor event loop."""
+        loop = asyncio.get_event_loop()
+        return await loop.run_in_executor(None, lambda: ray_tpu.get(ref, timeout=timeout))

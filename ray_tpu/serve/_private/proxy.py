@@ -1,0 +1,193 @@
+"""HTTP proxy: aiohttp server actor routing requests to deployments.
+
+Parity target: reference python/ray/serve/_private/proxy.py:750 (ProxyActor
+hosting an HTTP server per node; route table via long-poll; request ->
+router -> replica; response assembly :1137). The server runs on the
+replica actor's own asyncio loop (async actor), so request handling and
+response awaits interleave without threads-per-request.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import threading
+import time
+from typing import Optional
+
+import ray_tpu
+from ray_tpu.serve._private.replica import Request
+from ray_tpu.serve._private.router import get_router
+
+logger = logging.getLogger(__name__)
+
+
+class _AsyncResolver:
+    """Bridges ObjectRef completion to asyncio futures with ONE background
+    thread, so each in-flight HTTP request awaits a future instead of
+    parking a thread on a blocking get (the role of the reference proxy's
+    ASGI await on the handle's asyncio response)."""
+
+    def __init__(self, loop: asyncio.AbstractEventLoop):
+        self._loop = loop
+        self._pending: dict = {}  # ref -> asyncio future
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        threading.Thread(target=self._run, daemon=True,
+                         name="serve-proxy-resolver").start()
+
+    def submit(self, ref) -> asyncio.Future:
+        fut = self._loop.create_future()
+        with self._lock:
+            self._pending[ref] = fut
+        self._wake.set()
+        return fut
+
+    def _run(self):
+        while True:
+            with self._lock:
+                refs = list(self._pending)
+            if not refs:
+                self._wake.wait(timeout=0.5)
+                self._wake.clear()
+                continue
+            try:
+                done, _ = ray_tpu.wait(refs, num_returns=1, timeout=0.1)
+            except Exception:
+                time.sleep(0.05)
+                continue
+            for ref in done:
+                with self._lock:
+                    fut = self._pending.pop(ref, None)
+                if fut is None:
+                    continue
+                try:
+                    val = ray_tpu.get(ref, timeout=10)
+                    err = None
+                except Exception as e:  # noqa: BLE001
+                    val, err = None, e
+                self._loop.call_soon_threadsafe(_resolve_fut, fut, val, err)
+
+
+def _resolve_fut(fut: asyncio.Future, val, err):
+    if fut.done():
+        return
+    if err is not None:
+        fut.set_exception(err)
+    else:
+        fut.set_result(val)
+
+
+class Proxy:
+    def __init__(self, controller_name: str, host: str = "127.0.0.1",
+                 port: int = 8000):
+        self.controller_name = controller_name
+        self.host, self.port = host, port
+        self.routes: dict[str, str] = {}
+        self._version = -1
+        self._site = None
+        self._started = False
+        self._resolver: Optional[_AsyncResolver] = None
+
+    async def ready(self) -> int:
+        """Bind the HTTP server; returns the bound port."""
+        if self._started:
+            return self.port
+        from aiohttp import web
+
+        app = web.Application()
+        app.router.add_route("*", "/{tail:.*}", self._handle)
+        runner = web.AppRunner(app, access_log=None)
+        await runner.setup()
+        site = web.TCPSite(runner, self.host, self.port)
+        await site.start()
+        self._site = site
+        self._started = True
+        self._resolver = _AsyncResolver(asyncio.get_event_loop())
+        # Populate the route table BEFORE declaring ready: serve.run
+        # returns right after this, and the first request must not race
+        # the initial long-poll to a 404.
+        try:
+            controller = ray_tpu.get_actor(self.controller_name)
+            ref = controller.route_table.remote(-1, 0.0)
+            rep = await asyncio.get_event_loop().run_in_executor(
+                None, lambda r=ref: ray_tpu.get(r, timeout=10))
+            self._version = rep["version"]
+            self.routes = rep["routes"]
+        except Exception as e:
+            logger.warning("serve proxy initial route fetch failed: %r", e)
+        asyncio.ensure_future(self._route_poll_loop())
+        return self.port
+
+    async def _route_poll_loop(self):
+        while True:
+            try:
+                controller = ray_tpu.get_actor(self.controller_name)
+                ref = controller.route_table.remote(self._version, 10.0)
+                rep = await asyncio.get_event_loop().run_in_executor(
+                    None, lambda r=ref: ray_tpu.get(r, timeout=15))
+                self._version = rep["version"]
+                self.routes = rep["routes"]
+            except Exception as e:
+                logger.debug("serve proxy route poll error: %r", e)
+                await asyncio.sleep(0.2)
+
+    def _match(self, path: str) -> Optional[tuple[str, str]]:
+        best = None
+        for prefix, dep in self.routes.items():
+            norm = prefix.rstrip("/") or "/"
+            if path == norm or path.startswith(norm + "/") or norm == "/":
+                if best is None or len(norm) > len(best[0]):
+                    best = (norm, dep)
+        return best
+
+    async def _handle(self, request):
+        from aiohttp import web
+
+        m = self._match(request.path)
+        if m is None:
+            return web.Response(status=404, text="no deployment matches path")
+        _prefix, dep = m
+        body = await request.read()
+        req = Request(method=request.method, path=request.path,
+                      query=dict(request.query),
+                      headers=dict(request.headers), body=body)
+        router = get_router(self.controller_name, dep)
+        loop = asyncio.get_event_loop()
+
+        async def _once():
+            # assign only blocks when there are no replicas (rare), so the
+            # executor thread is held for microseconds, not the request
+            # duration; the result await costs no thread at all.
+            ref = await loop.run_in_executor(
+                None, lambda: router.assign("__call__", (req,), {}))
+            return await self._resolver.submit(ref)
+
+        try:
+            result = await _once()
+        except Exception as e:
+            from ray_tpu.exceptions import ActorDiedError, WorkerCrashedError
+
+            if isinstance(e, (ActorDiedError, WorkerCrashedError)):
+                # replica died mid-request: retry once on a survivor
+                try:
+                    result = await _once()
+                    return self._to_response(result)
+                except Exception as e2:  # noqa: F841
+                    e = e2
+            logger.error("serve proxy error: %r", e)
+            return web.Response(status=500, text=repr(e))
+        return self._to_response(result)
+
+    def _to_response(self, result):
+        from aiohttp import web
+
+        if isinstance(result, (dict, list)):
+            return web.json_response(result)
+        if isinstance(result, bytes):
+            return web.Response(body=result,
+                                content_type="application/octet-stream")
+        if isinstance(result, web.Response):
+            return result
+        return web.Response(text=str(result))

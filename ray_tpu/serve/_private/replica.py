@@ -1,0 +1,106 @@
+"""Serve replica: the actor that hosts one copy of a deployment.
+
+Parity target: reference python/ray/serve/_private/replica.py
+(UserCallableWrapper + Replica — construct the user callable once, execute
+requests with an ongoing-count the router/autoscaler read, drain before
+shutdown). Replicas are async actors: concurrent requests interleave on the
+actor's event loop up to max_ongoing_requests (reference replica
+max_concurrent_queries).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import json as _json
+from typing import Any, Optional
+
+
+class Request:
+    """Minimal HTTP request view handed to deployments (the role of the
+    reference's starlette.Request, proxy.py -> ASGI scope)."""
+
+    def __init__(self, method: str = "GET", path: str = "/", query: dict | None = None,
+                 headers: dict | None = None, body: bytes = b""):
+        self.method = method
+        self.path = path
+        self.query = dict(query or {})
+        self.headers = dict(headers or {})
+        self.body = body
+
+    def json(self):
+        return _json.loads(self.body or b"null")
+
+    @property
+    def query_params(self) -> dict:
+        return self.query
+
+    def __repr__(self):
+        return f"Request({self.method} {self.path})"
+
+
+class Replica:
+    """Wrapped by ray_tpu.remote at deploy time (controller attaches the
+    deployment's resource options)."""
+
+    def __init__(self, deployment: str, replica_id: str, callable_or_class,
+                 init_args: tuple, init_kwargs: dict):
+        self.deployment = deployment
+        self.replica_id = replica_id
+        if isinstance(callable_or_class, type):
+            self.callable = callable_or_class(*init_args, **(init_kwargs or {}))
+        else:
+            self.callable = callable_or_class
+        self.ongoing = 0
+        self.total = 0
+
+    async def ready(self) -> str:
+        """Constructor finished (actor creation ran __init__); used as the
+        readiness barrier before a replica enters the routing table."""
+        return self.replica_id
+
+    async def handle_request(self, method_name: str, args: tuple, kwargs: dict):
+        self.ongoing += 1
+        self.total += 1
+        try:
+            # Calling the instance itself covers both function deployments
+            # and class deployments' __call__.
+            target = (self.callable if method_name == "__call__"
+                      else getattr(self.callable, method_name))
+            if inspect.iscoroutinefunction(target) or (
+                    method_name == "__call__"
+                    and inspect.iscoroutinefunction(
+                        getattr(type(self.callable), "__call__", None))):
+                out = target(*args, **(kwargs or {}))
+            else:
+                # SYNC user code must not block the replica's event loop —
+                # it would serialize all in-flight requests and hide the
+                # real ongoing count from the autoscaler/router.
+                loop = asyncio.get_event_loop()
+                out = await loop.run_in_executor(
+                    None, lambda: target(*args, **(kwargs or {})))
+            if inspect.isawaitable(out):
+                out = await out
+            return out
+        finally:
+            self.ongoing -= 1
+
+    async def stats(self) -> dict:
+        return {"replica_id": self.replica_id, "ongoing": self.ongoing,
+                "total": self.total}
+
+    async def drain(self, timeout_s: float = 10.0) -> bool:
+        """Wait for in-flight requests to finish (reference graceful
+        shutdown, replica.py perform_graceful_shutdown)."""
+        deadline = asyncio.get_event_loop().time() + timeout_s
+        while self.ongoing > 0 and asyncio.get_event_loop().time() < deadline:
+            await asyncio.sleep(0.02)
+        return self.ongoing == 0
+
+    async def health_check(self) -> bool:
+        user_check = getattr(self.callable, "check_health", None)
+        if user_check is not None:
+            out = user_check()
+            if inspect.isawaitable(out):
+                await out
+        return True

@@ -1,0 +1,216 @@
+"""Client-side router: replica membership via long-poll, power-of-two
+choices balancing, and DeploymentHandle.
+
+Parity target: reference python/ray/serve/_private/router.py:321 (Router —
+per-handle replica scheduling) + replica_scheduler/pow_2_scheduler.py:52
+(sample two replicas, pick the lower outstanding count) + handle.py
+(DeploymentHandle/DeploymentResponse).
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+import time
+from typing import Any, Optional
+
+import ray_tpu
+
+logger = logging.getLogger(__name__)
+
+_routers: dict[str, "Router"] = {}
+_routers_lock = threading.Lock()
+
+
+def get_router(controller_name: str, deployment: str) -> "Router":
+    key = f"{controller_name}/{deployment}"
+    with _routers_lock:
+        r = _routers.get(key)
+        if r is None or r.dead:
+            r = _routers[key] = Router(controller_name, deployment)
+        return r
+
+
+def reset_routers():
+    with _routers_lock:
+        for r in _routers.values():
+            r.close()
+        _routers.clear()
+
+
+class Router:
+    def __init__(self, controller_name: str, deployment: str):
+        self.controller_name = controller_name
+        self.deployment = deployment
+        self.dead = False
+        self._replicas: list[tuple[str, Any]] = []
+        self._version = -1
+        self._have_replicas = threading.Event()
+        self._outstanding: dict[str, int] = {}
+        self._tracked: dict = {}  # result ref -> replica id
+        self._lock = threading.Lock()
+        self._closed = threading.Event()
+        threading.Thread(target=self._longpoll_loop, daemon=True,
+                         name=f"serve-router-{deployment}").start()
+        threading.Thread(target=self._drain_loop, daemon=True,
+                         name=f"serve-drain-{deployment}").start()
+
+    # ------------------------------------------------------------ membership
+    def _longpoll_loop(self):
+        while not self._closed.is_set():
+            try:
+                controller = ray_tpu.get_actor(self.controller_name)
+                rep = ray_tpu.get(
+                    controller.get_routing.remote(
+                        self.deployment, self._version, 10.0), timeout=15)
+                with self._lock:
+                    self._version = rep["version"]
+                    self._replicas = list(rep["replicas"])
+                    live = {rid for rid, _h in self._replicas}
+                    self._outstanding = {
+                        rid: n for rid, n in self._outstanding.items()
+                        if rid in live}
+                if self._replicas:
+                    self._have_replicas.set()
+                else:
+                    self._have_replicas.clear()
+            except Exception as e:
+                if self._closed.is_set():
+                    return
+                logger.debug("serve router long-poll error: %r", e)
+                time.sleep(0.2)
+
+    def _drain_loop(self):
+        """Decrement outstanding counts as responses resolve — the
+        client-side queue-length signal pow-2 balancing reads (reference
+        RouterMetricsManager.dec_num_running_requests_for_replica)."""
+        while not self._closed.is_set():
+            with self._lock:
+                refs = list(self._tracked)
+            if not refs:
+                time.sleep(0.005)
+                continue
+            try:
+                done, _ = ray_tpu.wait(refs, num_returns=1, timeout=0.2)
+            except Exception:
+                time.sleep(0.05)
+                continue
+            if done:
+                with self._lock:
+                    for ref in done:
+                        rid = self._tracked.pop(ref, None)
+                        if rid is not None and rid in self._outstanding:
+                            self._outstanding[rid] = max(
+                                0, self._outstanding[rid] - 1)
+
+    # --------------------------------------------------------------- assign
+    def assign(self, method_name: str, args: tuple, kwargs: dict,
+               timeout: float = 30.0):
+        """Pick a replica (pow-2 choices) and dispatch; returns the result
+        ObjectRef."""
+        deadline = time.monotonic() + timeout
+        while True:
+            left = deadline - time.monotonic()
+            if left <= 0 or not self._have_replicas.wait(timeout=left):
+                raise TimeoutError(
+                    f"no ready replicas for deployment {self.deployment!r}")
+            with self._lock:
+                reps = self._replicas
+                if not reps:
+                    pass  # emptied between the event wait and the lock
+                elif len(reps) == 1:
+                    rid, handle = reps[0]
+                    break
+                else:
+                    (r1, h1), (r2, h2) = random.sample(reps, 2)
+                    if self._outstanding.get(r1, 0) <= self._outstanding.get(r2, 0):
+                        rid, handle = r1, h1
+                    else:
+                        rid, handle = r2, h2
+                    break
+            time.sleep(0.02)  # rare: replica set emptied mid-assign
+        with self._lock:
+            self._outstanding[rid] = self._outstanding.get(rid, 0) + 1
+        ref = handle.handle_request.remote(method_name, args, kwargs)
+        with self._lock:
+            self._tracked[ref] = rid
+        return ref
+
+    def close(self):
+        self.dead = True
+        self._closed.set()
+
+
+class DeploymentResponse:
+    """reference serve/handle.py DeploymentResponse: a future for one
+    request; .result() retries once on replica death (the router has
+    already learned about the dead replica via long-poll by then)."""
+
+    def __init__(self, router: Router, method_name: str, args, kwargs, ref):
+        self._router = router
+        self._method = method_name
+        self._args, self._kwargs = args, kwargs
+        self._ref = ref
+
+    def result(self, timeout_s: float = 60.0):
+        from ray_tpu.exceptions import ActorDiedError, WorkerCrashedError
+
+        try:
+            return ray_tpu.get(self._ref, timeout=timeout_s)
+        except (ActorDiedError, WorkerCrashedError):
+            # replica died mid-request: route to a survivor once
+            self._ref = self._router.assign(self._method, self._args,
+                                            self._kwargs)
+            return ray_tpu.get(self._ref, timeout=timeout_s)
+
+    def __await__(self):
+        """`await handle.method.remote(x)` inside async deployments —
+        without blocking the replica's event loop (reference
+        DeploymentResponse is awaitable the same way)."""
+        import asyncio
+
+        loop = asyncio.get_event_loop()
+        return loop.run_in_executor(None, self.result).__await__()
+
+    def _to_object_ref(self):
+        return self._ref
+
+
+class DeploymentHandle:
+    """Picklable handle (reference serve/handle.py:DeploymentHandle):
+    carries (controller_name, deployment); the per-process router is
+    reconstructed lazily after unpickle, so handles can be passed into
+    other deployments for model composition."""
+
+    def __init__(self, deployment: str,
+                 controller_name: str = "_serve_controller",
+                 method_name: str = "__call__"):
+        self.deployment = deployment
+        self.controller_name = controller_name
+        self.method_name = method_name
+
+    @property
+    def _router(self) -> Router:
+        return get_router(self.controller_name, self.deployment)
+
+    def options(self, method_name: str) -> "DeploymentHandle":
+        return DeploymentHandle(self.deployment, self.controller_name,
+                                method_name)
+
+    def __getattr__(self, name: str) -> "DeploymentHandle":
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return DeploymentHandle(self.deployment, self.controller_name, name)
+
+    def remote(self, *args, **kwargs) -> DeploymentResponse:
+        ref = self._router.assign(self.method_name, args, kwargs)
+        return DeploymentResponse(self._router, self.method_name, args,
+                                  kwargs, ref)
+
+    def __reduce__(self):
+        return (DeploymentHandle,
+                (self.deployment, self.controller_name, self.method_name))
+
+    def __repr__(self):
+        return f"DeploymentHandle({self.deployment!r})"

@@ -222,9 +222,13 @@ class TuneController:
             if self._hit_stop_criteria(metrics):
                 self._stop_trial(trial)
                 return
+            runner_before = trial.runner
             decision = self.scheduler.on_trial_result(self, trial, metrics)
-            if trial.runner is None:
-                return  # scheduler restarted/killed it (PBT exploit)
+            if trial.runner is not runner_before:
+                # Scheduler restarted the trial (PBT exploit): _start
+                # already enqueued the new incarnation's poller — asking
+                # again would double-poll and reorder reports.
+                return
             if decision == sched_mod.STOP:
                 self._stop_trial(trial)
             else:

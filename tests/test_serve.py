@@ -1,0 +1,201 @@
+"""Serve: deployments, HTTP ingress, load balancing, composition,
+batching, autoscaling, rolling redeploy.
+
+reference tests: python/ray/serve/tests/test_standalone.py,
+test_deploy.py, test_autoscaling_policy.py, test_batching.py,
+test_model_composition.py.
+"""
+
+import json
+import os
+import socket
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def _http(url, data=None, timeout=30):
+    req = urllib.request.Request(url, data=data)
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.read()
+
+
+@pytest.fixture
+def serve_shutdown():
+    yield
+    serve.shutdown()
+    ray_tpu.shutdown()
+
+
+def test_deploy_function_and_http(serve_shutdown):
+    ray_tpu.init(num_cpus=4)
+
+    @serve.deployment
+    def hello(request):
+        name = request.query_params.get("name", "world")
+        return {"hello": name}
+
+    port = _free_port()
+    handle = serve.run(hello.bind(), port=port)
+    # handle path
+    assert handle.remote(serve.Request(query={"name": "via-handle"})).result() \
+        == {"hello": "via-handle"}
+    # HTTP path
+    out = json.loads(_http(f"http://127.0.0.1:{port}/?name=tpu"))
+    assert out == {"hello": "tpu"}
+
+
+def test_class_deployment_load_balanced(serve_shutdown):
+    ray_tpu.init(num_cpus=4)
+
+    @serve.deployment(num_replicas=2, ray_actor_options={"num_cpus": 1})
+    class Counter:
+        def __init__(self):
+            self.pid = os.getpid()
+
+        def __call__(self, request):
+            return {"pid": self.pid}
+
+    port = _free_port()
+    serve.run(Counter.bind(), port=port)
+    pids = set()
+    for _ in range(30):
+        out = json.loads(_http(f"http://127.0.0.1:{port}/"))
+        pids.add(out["pid"])
+    assert len(pids) == 2, "requests were not balanced across both replicas"
+
+
+def test_model_composition(serve_shutdown):
+    ray_tpu.init(num_cpus=4)
+
+    @serve.deployment
+    class Doubler:
+        def double(self, x):
+            return x * 2
+
+    @serve.deployment
+    class Ingress:
+        def __init__(self, doubler):
+            self.doubler = doubler
+
+        def __call__(self, request):
+            x = int(request.query_params.get("x", "1"))
+            return {"doubled": self.doubler.double.remote(x).result()}
+
+    port = _free_port()
+    serve.run(Ingress.bind(Doubler.bind()), port=port)
+    out = json.loads(_http(f"http://127.0.0.1:{port}/?x=21"))
+    assert out == {"doubled": 42}
+
+
+def test_batching(serve_shutdown):
+    ray_tpu.init(num_cpus=4)
+
+    @serve.deployment(max_ongoing_requests=32)
+    class Batcher:
+        def __init__(self):
+            self.batch_sizes = []
+
+        @serve.batch(max_batch_size=8, batch_wait_timeout_s=0.2)
+        async def handle_batch(self, items):
+            self.batch_sizes.append(len(items))
+            return [i * 10 for i in items]
+
+        async def __call__(self, request):
+            x = int(request.query_params.get("x", "0"))
+            return {"out": await self.handle_batch(x),
+                    "batches": list(self.batch_sizes)}
+
+    handle = serve.run(Batcher.bind(), port=_free_port())
+    # Fire 8 concurrent handle calls; they must coalesce into few batches.
+    resps = [handle.remote(serve.Request(query={"x": str(i)}))
+             for i in range(8)]
+    outs = [r.result() for r in resps]
+    assert sorted(o["out"] for o in outs) == [i * 10 for i in range(8)]
+    max_batch = max(max(o["batches"]) for o in outs)
+    assert max_batch >= 4, f"batching did not coalesce: {outs}"
+
+
+def test_autoscaling_up(serve_shutdown):
+    ray_tpu.init(num_cpus=4)
+
+    @serve.deployment(
+        autoscaling_config={"min_replicas": 1, "max_replicas": 3,
+                            "target_ongoing_requests": 1},
+        ray_actor_options={"num_cpus": 0.5},
+        max_ongoing_requests=16)
+    class Slow:
+        def __call__(self, request):
+            time.sleep(1.0)
+            return {"pid": os.getpid()}
+
+    handle = serve.run(Slow.bind(), port=_free_port())
+    assert serve.status()["Slow"]["ready"] == 1
+    # Sustained concurrent load -> controller must scale up.
+    resps = [handle.remote(serve.Request()) for _ in range(12)]
+    deadline = time.monotonic() + 30
+    scaled = False
+    while time.monotonic() < deadline:
+        if serve.status()["Slow"]["ready"] >= 2:
+            scaled = True
+            break
+        time.sleep(0.2)
+    for r in resps:
+        r.result(timeout_s=60)
+    assert scaled, "autoscaler never scaled up under sustained load"
+
+
+def test_rolling_redeploy_no_drop(serve_shutdown):
+    ray_tpu.init(num_cpus=4)
+
+    def make(version):
+        @serve.deployment(name="app", num_replicas=2,
+                          ray_actor_options={"num_cpus": 0.5},
+                          version=version)
+        class App:
+            def __call__(self, request):
+                return {"version": version}
+
+        return App
+
+    port = _free_port()
+    serve.run(make("v1").bind(), port=port)
+    seen, errors = set(), 0
+    # redeploy mid-traffic
+    import threading
+
+    stop = threading.Event()
+
+    def traffic():
+        nonlocal errors
+        while not stop.is_set():
+            try:
+                out = json.loads(_http(f"http://127.0.0.1:{port}/", timeout=10))
+                seen.add(out["version"])
+            except Exception:
+                errors += 1
+            time.sleep(0.02)
+
+    t = threading.Thread(target=traffic)
+    t.start()
+    time.sleep(0.5)
+    serve.run(make("v2").bind(), port=port)
+    deadline = time.monotonic() + 20
+    while "v2" not in seen and time.monotonic() < deadline:
+        time.sleep(0.1)
+    stop.set()
+    t.join()
+    assert "v1" in seen and "v2" in seen
+    assert errors == 0, f"{errors} requests dropped during rolling redeploy"
