@@ -87,6 +87,10 @@ class Controller:
         self.node_conns: dict[str, rpc.Connection] = {}
         self.client_conns: dict[str, rpc.Connection] = {}  # worker_id -> conn
         self.objects: dict[str, _ObjectEntry] = {}
+        # oid -> expiry: freed refs whose late advertises must not
+        # resurrect directory entries (see _p_free_objects)
+        self.freed_tombstones: dict[str, float] = {}
+        self._tombstone_prune_at = 0.0
         self.pending: deque[TaskSpec] = deque()
         # task_id -> {"spec", "node_id", "worker_id"}
         self.dispatched: dict[str, dict] = {}
@@ -373,6 +377,9 @@ class Controller:
                                       final_error=error)
             return
         for oid, inline, size, holder in a.get("results", []):
+            if self._freed(oid):
+                await self._purge_late(oid, holder)
+                continue
             ent = self.objects.setdefault(oid, _ObjectEntry())
             if ent.state == "ready" and ent.error is None and error is not None:
                 # Late/duplicate error report (e.g. a cancel SIGINT landing
@@ -436,6 +443,8 @@ class Controller:
             err_header, err_bufs = dumps_oob({"type": "WorkerCrashedError", "message": reason})
             final_error = [err_header, *err_bufs]
         for oid in spec.return_object_ids():
+            if self._freed(oid):
+                continue  # owner dropped the ref; don't resurrect the entry
             ent = self.objects.setdefault(oid, _ObjectEntry())
             ent.state = "ready"
             ent.error = final_error
@@ -447,6 +456,8 @@ class Controller:
 
         h, b = dumps_oob({"type": "TaskCancelledError", "message": f"task {spec.name} cancelled"})
         for oid in spec.return_object_ids():
+            if self._freed(oid):
+                continue  # owner dropped the ref; don't resurrect the entry
             ent = self.objects.setdefault(oid, _ObjectEntry())
             ent.state = "ready"
             ent.error = [h, *b]
@@ -660,6 +671,9 @@ class Controller:
 
     # ------------------------------------------------------------- objects
     async def _h_register_put(self, conn, a):
+        if self._freed(a["oid"]):
+            await self._purge_late(a["oid"], a.get("holder"))
+            return {}
         ent = self.objects.setdefault(a["oid"], _ObjectEntry())
         ent.state = "ready"
         ent.owner = a.get("owner") or conn.meta.get("worker_id")
@@ -694,6 +708,10 @@ class Controller:
         timeout = a.get("timeout")
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
+            if self._freed(oid):
+                # Owner already dropped its last reference: fail fast
+                # instead of resurrecting a permanently-pending entry.
+                return {"status": "lost"}
             ent = self.objects.setdefault(oid, _ObjectEntry())
             if ent.state == "ready":
                 return {
@@ -724,13 +742,56 @@ class Controller:
         return {"ready": out}
 
     async def _p_free_objects(self, conn, a):
+        """Owner dropped its last reference. Only fan the purge out to node
+        agents for objects that could actually have shm names there (a
+        non-inline holder) — inline results (every small task/actor return)
+        never touch /dev/shm, and purging them on every node made the agent
+        glob shm per freed oid. Tombstones catch the advertise-vs-free race:
+        a register that lands after the free must not resurrect the entry."""
         oids = a["oids"]
+        now = time.monotonic()
+        if self.freed_tombstones and now > self._tombstone_prune_at:
+            self._tombstone_prune_at = now + 10.0
+            self.freed_tombstones = {
+                o: t for o, t in self.freed_tombstones.items() if t > now}
+        shm_oids = []
         for oid in oids:
-            self.objects.pop(oid, None)
+            ent = self.objects.pop(oid, None)
+            # TTL must exceed any plausible task runtime: a fire-and-forget
+            # task finishing after the tombstone expires would resurrect the
+            # entry (and pin its shm segment forever).
+            self.freed_tombstones[oid] = now + 600.0
+            if ent is not None and ent.inline is None and ent.holders:
+                shm_oids.append(oid)
+        if len(self.freed_tombstones) > 200_000:  # hard cap, oldest first
+            for o in list(self.freed_tombstones)[:100_000]:
+                self.freed_tombstones.pop(o, None)
+        if shm_oids:
+            for nconn in self.node_conns.values():
+                if not nconn.closed:
+                    try:
+                        await nconn.push("free", oids=shm_oids)
+                    except Exception:
+                        pass
+
+    def _freed(self, oid: str) -> bool:
+        t = self.freed_tombstones.get(oid)
+        if t is None:
+            return False
+        if t <= time.monotonic():
+            self.freed_tombstones.pop(oid, None)
+            return False
+        return True
+
+    async def _purge_late(self, oid: str, holder):
+        """A result advertised after its ref was freed: purge the shm names
+        it just created (fire-and-forget tasks with large returns)."""
+        if holder is None:
+            return
         for nconn in self.node_conns.values():
             if not nconn.closed:
                 try:
-                    await nconn.push("free", oids=oids)
+                    await nconn.push("free", oids=[oid])
                 except Exception:
                     pass
 

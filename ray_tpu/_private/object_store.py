@@ -304,9 +304,15 @@ class LocalStore:
         return clean
 
     def _unlink_pins(self, oid: str) -> None:
-        import glob as _glob
-
-        for p in _glob.glob(self._path(oid) + ".p*"):
+        # scandir + startswith instead of glob: glob compiles a regex per
+        # call, and this runs on every purge.
+        prefix = os.path.basename(self._path(oid)) + ".p"
+        try:
+            with os.scandir(self.shm_dir) as it:
+                victims = [e.path for e in it if e.name.startswith(prefix)]
+        except OSError:
+            return
+        for p in victims:
             try:
                 os.unlink(p)
             except OSError:

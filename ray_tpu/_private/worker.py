@@ -111,19 +111,27 @@ def _borrowed_ref(oid: str) -> ObjectRef:
 
 
 class _Resolution:
-    __slots__ = ("event", "inline", "holders", "error")
+    __slots__ = ("event", "inline", "holders", "error", "watchers")
 
     def __init__(self):
         self.event = threading.Event()
         self.inline = None
         self.holders: list = []
         self.error = None
+        self.watchers = None  # lazily-created list of resolve callbacks
 
     def resolve(self, inline, holders, error):
         self.inline = inline
         self.holders = holders or []
         self.error = error
         self.event.set()
+        if self.watchers:
+            ws, self.watchers = self.watchers, None
+            for cb in ws:
+                try:
+                    cb()
+                except Exception:
+                    pass
 
     def reset(self):
         """Re-arm in place (reconstruction): getters already blocked on
@@ -167,6 +175,9 @@ class Worker:
         # Owned-object bookkeeping (reference ReferenceCounter):
         self._refcounts: dict[str, int] = {}
         self._refcounts_lock = threading.Lock()
+        self._free_buf: list[str] = []
+        self._free_scheduled = False
+        self._escaped: set[str] = set()  # owned oids advertised on escape
         self._resolutions: dict[str, _Resolution] = {}
         self._inline_cache: dict[str, list] = {}  # oid -> blob parts (small objs)
         self._lineage: dict[str, TaskSpec] = {}  # return oid -> producing spec
@@ -327,11 +338,31 @@ class Worker:
             self._inline_cache.pop(oid, None)
             self._resolutions.pop(oid, None)
             self._lineage.pop(oid, None)
+            self._escaped.discard(oid)
             self.store.delete(oid)
-        try:
-            self.controller.push_threadsafe("free_objects", oids=oids)
-        except Exception:
-            pass
+        # Batch the controller notification: refs die one at a time (GC),
+        # but a burst of dying refs (the common teardown of a get() over
+        # many results) must not cost one controller frame each.
+        with self._refcounts_lock:
+            self._free_buf.extend(oids)
+            need = not self._free_scheduled
+            self._free_scheduled = True
+        if need:
+            try:
+                self.io.spawn(self._a_flush_free())
+            except Exception:
+                pass
+
+    async def _a_flush_free(self):
+        await asyncio.sleep(0.002)  # coalesce the burst
+        with self._refcounts_lock:
+            oids, self._free_buf = self._free_buf, []
+            self._free_scheduled = False
+        if oids and not self._shutdown:
+            try:
+                await self.controller.push("free_objects", oids=oids)
+            except Exception:
+                pass
 
     # ----------------------------------------------------------------- put
     def put(self, value) -> ObjectRef:
@@ -339,6 +370,10 @@ class Worker:
             raise TypeError("Calling put() on an ObjectRef is not allowed.")
         oid = ObjectID.from_put().hex()
         sobj = serialize(value, ref_class=ObjectRef)
+        if sobj.contained_refs:  # refs escape into the putted payload
+            self._advertise_escaping(
+                [r.hex() if isinstance(r, ObjectRef) else r
+                 for r in sobj.contained_refs])
         self._store_blob(oid, sobj, register=True)
         return ObjectRef(oid, owned=True, worker=self)
 
@@ -382,12 +417,16 @@ class Worker:
 
     def _get_one(self, ref: ObjectRef, deadline):
         oid = ref.hex()
-        # 1. local caches (in-process inline / same-host shm, zero-copy)
+        # 1. owned refs already resolved: straight to materialize (the hot
+        # path for harvesting a batch of results — skips two cache probes)
+        res = self._resolutions.get(oid)
+        if res is not None and res.event.is_set():
+            return self._materialize(oid, res.inline, res.holders, res.error, deadline)
+        # 2. local caches (in-process inline / same-host shm, zero-copy)
         val, found = self._try_local(oid)
         if found:
             return val
-        # 2. owned refs: wait for the controller's object_ready push
-        res = self._resolutions.get(oid)
+        # 3. owned refs: wait for the controller's object_ready push
         if res is not None:
             if not res.event.wait(timeout=self._remaining(deadline)):
                 raise exc.GetTimeoutError(f"get() timed out on {oid[:16]}")
@@ -603,14 +642,31 @@ class Worker:
         return fn
 
     def _encode_args(self, args, kwargs):
-        enc_args = [self._encode_one(a) for a in args]
-        enc_kwargs = {k: self._encode_one(v) for k, v in kwargs.items()}
-        return enc_args, enc_kwargs
+        """Returns (enc_args, enc_kwargs, escaping_oids). escaping_oids are
+        the refs shipped inside this payload — the submitter must PIN the
+        owned ones until the task completes (reference: task arguments hold
+        references, reference_count.h AddLocalReference for args), or
+        rebinding the Python variable frees the arg before the worker can
+        read it."""
+        escapes: list[str] = []
+        enc_args = [self._encode_one(a, escapes) for a in args]
+        enc_kwargs = {k: self._encode_one(v, escapes) for k, v in kwargs.items()}
+        return enc_args, enc_kwargs, escapes
 
-    def _encode_one(self, value):
+    def _encode_one(self, value, escapes: list | None = None):
         if isinstance(value, ObjectRef):
-            return ("ref", value.hex())
+            oid = value.hex()
+            self._advertise_escaping([oid])
+            if escapes is not None:
+                escapes.append(oid)
+            return ("ref", oid)
         sobj = serialize(value, ref_class=ObjectRef)
+        if sobj.contained_refs:
+            oids = [r.hex() if isinstance(r, ObjectRef) else r
+                    for r in sobj.contained_refs]
+            self._advertise_escaping(oids)
+            if escapes is not None:
+                escapes.extend(oids)
         if sobj.total_bytes() <= CONFIG.max_inline_object_bytes:
             return ("v", sobj.to_bytes())
         # Large argument: promote to an owned object (reference puts >100KB
@@ -620,7 +676,78 @@ class Worker:
         self._incref(oid)  # pinned for the duration of the session put
         return ("ref", oid)
 
+    def _pin_args_until_done(self, escapes: list[str], refs: list):
+        """incref owned arg refs now; decref when the task's first return
+        resolves (value, error, or cancellation all resolve)."""
+        if not escapes or not refs:
+            return
+        pinned = [o for o in escapes if o in self._refcounts]
+        if not pinned:
+            return
+        for o in pinned:
+            self._incref(o)
+        res = self._resolutions.get(refs[0].hex())
+        if res is None:
+            for o in pinned:
+                self._decref(o)
+            return
+        fired = []
+
+        def _unpin(_pinned=tuple(pinned)):
+            if fired:
+                return
+            fired.append(1)
+            for o in _pinned:
+                self._decref(o)
+
+        if res.watchers is None:
+            res.watchers = []
+        res.watchers.append(_unpin)
+        if res.event.is_set():
+            _unpin()  # resolve raced the append; _unpin is idempotent
+
+    def _advertise_escaping(self, oids: list[str]):
+        """Owner-side escape analysis at the serialization boundary: a ref
+        can only be BORROWED after its owner ships it inside a payload, so
+        inline results (which are no longer eagerly advertised on the
+        direct-call paths) are registered with the controller exactly when
+        they first escape. Shm results and puts are advertised at creation
+        (they name a fetchable holder); borrowed refs are skipped (their
+        owner advertised them before they reached us)."""
+        for oid in oids:
+            if oid in self._escaped:
+                continue
+            res = self._resolutions.get(oid)
+            if res is None:
+                continue  # not ours
+            self._escaped.add(oid)
+            if res.event.is_set():
+                self._push_escape_advertise(oid, res)
+            else:
+                # Advertise the moment it resolves. The append/is_set
+                # re-check closes the race with resolve(); a double
+                # register_put push is idempotent.
+                if res.watchers is None:
+                    res.watchers = []
+                res.watchers.append(
+                    lambda o=oid, r=res: self._push_escape_advertise(o, r))
+                if res.event.is_set():
+                    self._push_escape_advertise(oid, res)
+
+    def _push_escape_advertise(self, oid: str, res: "_Resolution"):
+        if res.inline is None and res.error is None:
+            return  # shm result: the executing worker advertised the holder
+        size = sum(len(p) for p in res.inline) if res.inline else 0
+        try:
+            self.controller.push_threadsafe(
+                "register_put", oid=oid, size=size, inline=res.inline,
+                holder=None, owner=self.worker_id, error=res.error)
+        except Exception:
+            pass
+
     def decode_args(self, enc_args, enc_kwargs):
+        if not enc_args and not enc_kwargs:
+            return (), {}
         args = [self._decode_one(e) for e in enc_args]
         kwargs = {k: self._decode_one(e) for k, e in enc_kwargs.items()}
         return args, kwargs
@@ -635,7 +762,8 @@ class Worker:
                     strategy: SchedulingStrategy | None = None, max_retries: int | None = None,
                     retry_exceptions=False, runtime_env=None) -> list[ObjectRef]:
         fid = self._register_function(fn)
-        enc_args, enc_kwargs = self._encode_args(args, kwargs)
+        enc_args, enc_kwargs, escapes = (self._encode_args(args, kwargs)
+                                         if (args or kwargs) else ([], {}, []))
         task_id = TaskID.from_random().hex()
         spec = TaskSpec(
             task_id=task_id,
@@ -659,6 +787,7 @@ class Worker:
             if spec.max_retries != 0:
                 self._lineage[oid] = spec
             refs.append(ObjectRef(oid, owned=True, worker=self))
+        self._pin_args_until_done(escapes, refs)
         # Direct path: lease workers by scheduling class and stream specs to
         # them (reference NormalTaskSubmitter lease pools). TPU tasks keep
         # the controller-dispatch path — they need a dedicated worker whose
@@ -722,7 +851,13 @@ class Worker:
         from ray_tpu._private.ids import ActorID
 
         fid = self._register_function(cls)
-        enc_args, enc_kwargs = self._encode_args(args, kwargs)
+        enc_args, enc_kwargs, escapes = self._encode_args(args, kwargs)
+        # Actor init args must survive RESTARTS (the controller re-runs
+        # __init__ from the same spec), so owned arg refs stay pinned for
+        # the session (reference: the GCS holds actor creation specs).
+        for o in escapes:
+            if o in self._refcounts:
+                self._incref(o)
         actor_id = ActorID.from_random().hex()
         spec = TaskSpec(
             task_id=TaskID.from_random().hex(),
@@ -766,7 +901,8 @@ class Worker:
 
     def submit_actor_task(self, actor_id: str, method_name: str, args, kwargs, *,
                           num_returns=1, name=None, max_task_retries=0) -> list[ObjectRef]:
-        enc_args, enc_kwargs = self._encode_args(args, kwargs)
+        enc_args, enc_kwargs, escapes = (self._encode_args(args, kwargs)
+                                         if (args or kwargs) else ([], {}, []))
         task_id = TaskID.from_random().hex()
         spec = TaskSpec(
             task_id=task_id,
@@ -785,6 +921,7 @@ class Worker:
         for oid in spec.return_object_ids():
             self._resolutions[oid] = _Resolution()
             refs.append(ObjectRef(oid, owned=True, worker=self))
+        self._pin_args_until_done(escapes, refs)
         pipe = self._actor_pipes.get(actor_id)
         if pipe is None:
             with self._submit_lock:

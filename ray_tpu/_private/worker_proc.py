@@ -302,6 +302,13 @@ class WorkerProc:
             )
         for oid, v in zip(oids, values):
             sobj = serialize(v, ref_class=ObjectRef)
+            if sobj.contained_refs:
+                # Returned refs escape to the caller here: refs THIS worker
+                # owns (results of its own sub-calls) must reach the
+                # controller before the borrower can possibly wait on them.
+                self.worker._advertise_escaping(
+                    [r.hex() if isinstance(r, ObjectRef) else r
+                     for r in sobj.contained_refs])
             size = sobj.total_bytes()
             if size <= CONFIG.max_inline_object_bytes:
                 results.append((oid, [sobj.to_bytes()], size, None))
@@ -490,13 +497,19 @@ class WorkerProc:
                    "results": results, "error": error_blob, "retryable": retryable}
         # Don't advertise transient (to-be-retried) errors: the owner will
         # resubmit, and a poisoned directory entry would outlive the retry.
+        # Inline results aren't advertised at all: the owner resolves from
+        # the direct reply, and a third-party borrower is served on demand
+        # via the controller's need_object pull to the owner (reference:
+        # owned inline objects live with the owner, not in the GCS).
         will_retry = (error_blob is not None and retryable
                       and spec.attempt < spec.max_retries)
         if not will_retry:
             for oid, inline, size, holder in results:
-                self._advertise_pusher.add(
-                    {"oid": oid, "size": size, "inline": inline, "holder": holder,
-                     "owner": spec.owner_id, "error": error_blob})
+                if holder is not None:
+                    self._advertise_pusher.add(
+                        {"oid": oid, "size": size, "inline": inline,
+                         "holder": holder, "owner": spec.owner_id,
+                         "error": error_blob})
         for _ in range(2):  # a late cancel SIGINT must not lose the report
             try:
                 if pusher is not None:  # holder gone: report has no audience
@@ -525,13 +538,16 @@ class WorkerProc:
             error_blob = self._make_error_blob(spec, e)
             results = self._package_results(spec, None, error_blob)
 
-        # Advertise results to the controller (batched one-way frames) so
-        # refs passed on to third parties resolve; the caller gets them in
-        # the reply already.
+        # Advertise shm results to the controller (batched one-way frames)
+        # so refs passed to third parties resolve; inline results live with
+        # the owner (who gets them in the reply) and are served to borrowers
+        # via the controller's need_object pull.
         for oid, inline, size, holder in results:
-            self._advertise_pusher.add(
-                {"oid": oid, "size": size, "inline": inline, "holder": holder,
-                 "owner": spec.owner_id, "error": error_blob})
+            if holder is not None:
+                self._advertise_pusher.add(
+                    {"oid": oid, "size": size, "inline": inline,
+                     "holder": holder, "owner": spec.owner_id,
+                     "error": error_blob})
         return {"results": results, "error": error_blob}
 
 

@@ -56,12 +56,18 @@ class ActorHandle:
     def __getattr__(self, name: str) -> ActorMethod:
         if name.startswith("_"):
             raise AttributeError(name)
-        return ActorMethod(self, name)
+        # Cache in the instance dict: the next `handle.method` skips
+        # __getattr__ (and the ActorMethod alloc) entirely — actor call
+        # dispatch is a hot path.
+        m = ActorMethod(self, name)
+        self.__dict__[name] = m
+        return m
 
     def __repr__(self):
         return f"ActorHandle({self._actor_id[:12]})"
 
     def __reduce__(self):
+        # NB: cached ActorMethods in __dict__ are deliberately not pickled.
         return (ActorHandle, (self._actor_id, self._max_task_retries))
 
     def __hash__(self):

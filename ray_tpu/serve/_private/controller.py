@@ -29,6 +29,7 @@ CONTROLLER_NAME = "_serve_controller"
 PROXY_NAME = "_serve_proxy"
 RECONCILE_INTERVAL_S = 0.2
 AUTOSCALE_INTERVAL_S = 0.5
+HEALTH_INTERVAL_S = 1.0
 DOWNSCALE_PATIENCE = 4  # consecutive intervals below target before shrink
 
 
@@ -62,6 +63,7 @@ class ServeController:
         # rolling updates: deployment -> old-generation replicas still
         # serving until the new generation is ready
         self._retire_after_ready: dict[str, dict] = {}
+        self._health_inflight: set[str] = set()
 
     # ------------------------------------------------------------ plumbing
     def _ensure_loop(self):
@@ -172,6 +174,7 @@ class ServeController:
     # ----------------------------------------------------------- reconcile
     async def _control_loop(self):
         last_autoscale = 0.0
+        last_health = 0.0
         while not self._shutdown:
             try:
                 now = time.monotonic()
@@ -182,9 +185,37 @@ class ServeController:
                     for name, st in list(self.deployments.items()):
                         if st.spec.get("autoscaling_config"):
                             await self._autoscale(name, st)
+                if now - last_health >= HEALTH_INTERVAL_S:
+                    last_health = now
+                    for name, st in list(self.deployments.items()):
+                        for rid, r in list(st.replicas.items()):
+                            if r["ready"] and rid not in self._health_inflight:
+                                self._health_inflight.add(rid)
+                                asyncio.ensure_future(
+                                    self._check_replica(name, st, rid, r["handle"]))
             except Exception:
                 logger.exception("serve controller reconcile error")
             await asyncio.sleep(RECONCILE_INTERVAL_S)
+
+    async def _check_replica(self, name: str, st: _DeploymentState,
+                             rid: str, handle):
+        """Dead-replica detection (reference deployment_state health checks):
+        an unhealthy replica leaves the routing table immediately; the
+        reconciler replaces it on the next tick."""
+        try:
+            await self._async_get(handle.health_check.remote(), timeout=5)
+        except Exception as e:
+            if (name in self.deployments and self.deployments[name] is st
+                    and st.replicas.pop(rid, None) is not None):
+                logger.warning("serve: replica %s failed health check (%r); "
+                               "replacing", rid, e)
+                self._bump()
+                # Actually stop it: a live-but-stuck replica would otherwise
+                # keep its actor + resource reservation forever, starving
+                # the replacement.
+                asyncio.ensure_future(self._stop_replica(handle))
+        finally:
+            self._health_inflight.discard(rid)
 
     async def _reconcile(self, name: str, st: _DeploymentState):
         # Scale up.

@@ -12,71 +12,13 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
-import threading
-import time
 from typing import Optional
 
 import ray_tpu
 from ray_tpu.serve._private.replica import Request
-from ray_tpu.serve._private.router import get_router
+from ray_tpu.serve._private.router import get_router, resolver_for
 
 logger = logging.getLogger(__name__)
-
-
-class _AsyncResolver:
-    """Bridges ObjectRef completion to asyncio futures with ONE background
-    thread, so each in-flight HTTP request awaits a future instead of
-    parking a thread on a blocking get (the role of the reference proxy's
-    ASGI await on the handle's asyncio response)."""
-
-    def __init__(self, loop: asyncio.AbstractEventLoop):
-        self._loop = loop
-        self._pending: dict = {}  # ref -> asyncio future
-        self._lock = threading.Lock()
-        self._wake = threading.Event()
-        threading.Thread(target=self._run, daemon=True,
-                         name="serve-proxy-resolver").start()
-
-    def submit(self, ref) -> asyncio.Future:
-        fut = self._loop.create_future()
-        with self._lock:
-            self._pending[ref] = fut
-        self._wake.set()
-        return fut
-
-    def _run(self):
-        while True:
-            with self._lock:
-                refs = list(self._pending)
-            if not refs:
-                self._wake.wait(timeout=0.5)
-                self._wake.clear()
-                continue
-            try:
-                done, _ = ray_tpu.wait(refs, num_returns=1, timeout=0.1)
-            except Exception:
-                time.sleep(0.05)
-                continue
-            for ref in done:
-                with self._lock:
-                    fut = self._pending.pop(ref, None)
-                if fut is None:
-                    continue
-                try:
-                    val = ray_tpu.get(ref, timeout=10)
-                    err = None
-                except Exception as e:  # noqa: BLE001
-                    val, err = None, e
-                self._loop.call_soon_threadsafe(_resolve_fut, fut, val, err)
-
-
-def _resolve_fut(fut: asyncio.Future, val, err):
-    if fut.done():
-        return
-    if err is not None:
-        fut.set_exception(err)
-    else:
-        fut.set_result(val)
 
 
 class Proxy:
@@ -88,7 +30,7 @@ class Proxy:
         self._version = -1
         self._site = None
         self._started = False
-        self._resolver: Optional[_AsyncResolver] = None
+        self._resolver = None
 
     async def ready(self) -> int:
         """Bind the HTTP server; returns the bound port."""
@@ -104,7 +46,7 @@ class Proxy:
         await site.start()
         self._site = site
         self._started = True
-        self._resolver = _AsyncResolver(asyncio.get_event_loop())
+        self._resolver = resolver_for(asyncio.get_event_loop())
         # Populate the route table BEFORE declaring ready: serve.run
         # returns right after this, and the first request must not race
         # the initial long-poll to a 404.

@@ -23,6 +23,86 @@ _routers: dict[str, "Router"] = {}
 _routers_lock = threading.Lock()
 
 
+class AsyncResolver:
+    """Bridges ObjectRef completion to asyncio futures with ONE background
+    thread per event loop, so awaiting a response never parks a thread for
+    the request duration (used by the HTTP proxy and by awaited
+    DeploymentResponses inside async deployments)."""
+
+    def __init__(self, loop):
+        import asyncio  # noqa: F401 (loop comes from the caller)
+
+        self._loop = loop
+        self._pending: dict = {}  # ref -> asyncio future
+        self._lock = threading.Lock()
+        self._wake = threading.Event()
+        threading.Thread(target=self._run, daemon=True,
+                         name="serve-resolver").start()
+
+    def submit(self, ref):
+        fut = self._loop.create_future()
+        with self._lock:
+            self._pending[ref] = fut
+        self._wake.set()
+        return fut
+
+    def _run(self):
+        while True:
+            if self._loop.is_closed():
+                # Loop gone (serve torn down in this process): stop polling
+                # and drop the registry entry so loop + thread can be GC'd.
+                with _resolvers_lock:
+                    if _loop_resolvers.get(id(self._loop)) is self:
+                        _loop_resolvers.pop(id(self._loop), None)
+                return
+            with self._lock:
+                refs = list(self._pending)
+            if not refs:
+                self._wake.wait(timeout=0.5)
+                self._wake.clear()
+                continue
+            try:
+                done, _ = ray_tpu.wait(refs, num_returns=1, timeout=0.1)
+            except Exception:
+                time.sleep(0.05)
+                continue
+            for ref in done:
+                with self._lock:
+                    fut = self._pending.pop(ref, None)
+                if fut is None:
+                    continue
+                try:
+                    val = ray_tpu.get(ref, timeout=10)
+                    err = None
+                except Exception as e:  # noqa: BLE001
+                    val, err = None, e
+                try:
+                    self._loop.call_soon_threadsafe(_resolve_fut, fut, val, err)
+                except RuntimeError:
+                    pass  # loop closed under us
+
+
+def _resolve_fut(fut, val, err):
+    if fut.done():
+        return
+    if err is not None:
+        fut.set_exception(err)
+    else:
+        fut.set_result(val)
+
+
+_loop_resolvers: dict = {}
+_resolvers_lock = threading.Lock()
+
+
+def resolver_for(loop) -> AsyncResolver:
+    with _resolvers_lock:
+        r = _loop_resolvers.get(id(loop))
+        if r is None:
+            r = _loop_resolvers[id(loop)] = AsyncResolver(loop)
+        return r
+
+
 def get_router(controller_name: str, deployment: str) -> "Router":
     key = f"{controller_name}/{deployment}"
     with _routers_lock:
@@ -166,12 +246,23 @@ class DeploymentResponse:
 
     def __await__(self):
         """`await handle.method.remote(x)` inside async deployments —
-        without blocking the replica's event loop (reference
-        DeploymentResponse is awaitable the same way)."""
+        costs no thread while the downstream request runs (one shared
+        resolver thread per loop; reference DeploymentResponse is
+        awaitable the same way)."""
+        return self._aresult().__await__()
+
+    async def _aresult(self):
         import asyncio
 
-        loop = asyncio.get_event_loop()
-        return loop.run_in_executor(None, self.result).__await__()
+        from ray_tpu.exceptions import ActorDiedError, WorkerCrashedError
+
+        resolver = resolver_for(asyncio.get_event_loop())
+        try:
+            return await resolver.submit(self._ref)
+        except (ActorDiedError, WorkerCrashedError):
+            self._ref = self._router.assign(self._method, self._args,
+                                            self._kwargs)
+            return await resolver.submit(self._ref)
 
     def _to_object_ref(self):
         return self._ref

@@ -83,3 +83,27 @@ def test_cluster_resources_api(ray_start_2cpu):
     assert total["CPU"] == 2.0
     assert ray_tpu.available_resources()["CPU"] <= 2.0
     assert len(ray_tpu.nodes()) == 1
+
+
+def test_returned_borrowed_ref_resolves(ray_start_2cpu):
+    """A small inline ref created by one actor and RETURNED (not gotten) by
+    its owner to the driver must resolve for the borrower — the owner
+    advertises owned refs when they escape inside a return value."""
+
+    @ray_tpu.remote
+    class Maker:
+        def make(self, v):
+            return v * 2
+
+    @ray_tpu.remote
+    class Owner:
+        def __init__(self):
+            self.maker = Maker.remote()
+
+        def indirect(self, v):
+            # returns the REF itself; the driver becomes a borrower
+            return self.maker.make.remote(v)
+
+    owner = Owner.remote()
+    inner_ref = ray_tpu.get(owner.indirect.remote(21), timeout=60)
+    assert ray_tpu.get(inner_ref, timeout=30) == 42

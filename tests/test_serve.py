@@ -199,3 +199,36 @@ def test_rolling_redeploy_no_drop(serve_shutdown):
     t.join()
     assert "v1" in seen and "v2" in seen
     assert errors == 0, f"{errors} requests dropped during rolling redeploy"
+
+
+def test_replica_death_recovery(serve_shutdown):
+    """A dead replica must leave the routing table (health check) and be
+    replaced by the reconciler; traffic keeps succeeding."""
+    ray_tpu.init(num_cpus=4)
+
+    @serve.deployment(num_replicas=2, ray_actor_options={"num_cpus": 0.5})
+    class P:
+        def __call__(self, request):
+            return {"pid": os.getpid()}
+
+    port = _free_port()
+    handle = serve.run(P.bind(), port=port)
+    first = json.loads(_http(f"http://127.0.0.1:{port}/"))["pid"]
+    # Kill one replica process out from under serve.
+    os.kill(first, 9)
+    deadline = time.monotonic() + 30
+    pids = set()
+    while time.monotonic() < deadline:
+        try:
+            pids.add(json.loads(_http(f"http://127.0.0.1:{port}/"))["pid"])
+        except Exception:
+            pass  # transient while the dead replica is being evicted
+        if len(pids - {first}) >= 2:
+            break
+        time.sleep(0.2)
+    assert len(pids - {first}) >= 2, (
+        f"replacement replica never appeared: {pids}")
+    # Steady state: requests no longer fail.
+    for _ in range(10):
+        out = json.loads(_http(f"http://127.0.0.1:{port}/"))
+        assert out["pid"] != first
