@@ -168,6 +168,46 @@ def main():
     except Exception as e:  # no TPU in this environment
         log(f"  tpu matmul skipped: {e}")
 
+    # ---- Pallas flash attention TFLOP/s (single chip) --------------------
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        if jax.devices()[0].platform == "tpu":
+            from ray_tpu.ops.flash_attention import flash_attention
+
+            b_, s_, h_, d_ = 4, 2048, 8, 128
+            key = jax.random.PRNGKey(0)
+            qa = jax.random.normal(key, (b_, s_, h_, d_), jnp.bfloat16)
+            ka = jax.random.normal(key, (b_, s_, h_, d_), jnp.bfloat16)
+            va = jax.random.normal(key, (b_, s_, h_, d_), jnp.bfloat16)
+
+            def attn_chain(qx, iters):
+                def body(i, acc):
+                    return flash_attention(acc, ka, va, causal=True)
+                y = jax.lax.fori_loop(0, iters, body, qx)
+                return jnp.float32(y.astype(jnp.float32).sum())
+
+            fa = jax.jit(attn_chain, static_argnums=1)
+
+            def run_a(iters):
+                t0 = time.perf_counter()
+                float(fa(qa, iters))
+                return time.perf_counter() - t0
+
+            run_a(2)
+            run_a(34)
+            t_short = min(run_a(2) for _ in range(3))
+            t_long = min(run_a(34) for _ in range(3))
+            per_call = (t_long - t_short) / 32
+            # useful causal flops: 4*b*h*s^2*d * 1/2
+            aflops = 4 * b_ * h_ * s_ * s_ * d_ * 0.5 / per_call
+            results["flash_attention_tflops"] = aflops / 1e12
+            log(f"  flash attention: {aflops/1e12:.1f} TFLOP/s "
+                f"(causal, b{b_} s{s_} h{h_} d{d_})")
+    except Exception as e:
+        log(f"  flash attention skipped: {e}")
+
     ray_tpu.shutdown()
 
     ratios = {k: results[k] / BASELINES[k] for k in BASELINES if k in results}

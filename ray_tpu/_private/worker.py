@@ -110,6 +110,9 @@ def _borrowed_ref(oid: str) -> ObjectRef:
     return ObjectRef(oid, owned=False, worker=global_worker())
 
 
+_watchers_lock = threading.Lock()
+
+
 class _Resolution:
     __slots__ = ("event", "inline", "holders", "error", "watchers")
 
@@ -120,18 +123,31 @@ class _Resolution:
         self.error = None
         self.watchers = None  # lazily-created list of resolve callbacks
 
+    def add_watcher(self, cb) -> bool:
+        """Run cb at resolve time, exactly once. Returns False if already
+        resolved — the CALLER must then run cb itself. The lock serializes
+        against resolve()'s swap so a callback can never be lost or run
+        twice."""
+        with _watchers_lock:
+            if self.event.is_set():
+                return False
+            if self.watchers is None:
+                self.watchers = []
+            self.watchers.append(cb)
+            return True
+
     def resolve(self, inline, holders, error):
         self.inline = inline
         self.holders = holders or []
         self.error = error
         self.event.set()
-        if self.watchers:
+        with _watchers_lock:
             ws, self.watchers = self.watchers, None
-            for cb in ws:
-                try:
-                    cb()
-                except Exception:
-                    pass
+        for cb in ws or ():
+            try:
+                cb()
+            except Exception:
+                pass
 
     def reset(self):
         """Re-arm in place (reconstruction): getters already blocked on
@@ -351,7 +367,10 @@ class Worker:
             try:
                 self.io.spawn(self._a_flush_free())
             except Exception:
-                pass
+                # Un-wedge: the next free must be able to reschedule the
+                # flush or the controller never hears about any of them.
+                with self._refcounts_lock:
+                    self._free_scheduled = False
 
     async def _a_flush_free(self):
         await asyncio.sleep(0.002)  # coalesce the burst
@@ -691,20 +710,12 @@ class Worker:
             for o in pinned:
                 self._decref(o)
             return
-        fired = []
-
         def _unpin(_pinned=tuple(pinned)):
-            if fired:
-                return
-            fired.append(1)
             for o in _pinned:
                 self._decref(o)
 
-        if res.watchers is None:
-            res.watchers = []
-        res.watchers.append(_unpin)
-        if res.event.is_set():
-            _unpin()  # resolve raced the append; _unpin is idempotent
+        if not res.add_watcher(_unpin):
+            _unpin()  # already resolved
 
     def _advertise_escaping(self, oids: list[str]):
         """Owner-side escape analysis at the serialization boundary: a ref
@@ -721,18 +732,9 @@ class Worker:
             if res is None:
                 continue  # not ours
             self._escaped.add(oid)
-            if res.event.is_set():
-                self._push_escape_advertise(oid, res)
-            else:
-                # Advertise the moment it resolves. The append/is_set
-                # re-check closes the race with resolve(); a double
-                # register_put push is idempotent.
-                if res.watchers is None:
-                    res.watchers = []
-                res.watchers.append(
-                    lambda o=oid, r=res: self._push_escape_advertise(o, r))
-                if res.event.is_set():
-                    self._push_escape_advertise(oid, res)
+            cb = (lambda o=oid, r=res: self._push_escape_advertise(o, r))
+            if not res.add_watcher(cb):
+                cb()  # already resolved: advertise now
 
     def _push_escape_advertise(self, oid: str, res: "_Resolution"):
         if res.inline is None and res.error is None:

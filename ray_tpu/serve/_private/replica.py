@@ -85,7 +85,11 @@ class Replica:
         finally:
             self.ongoing -= 1
 
-    async def stats(self) -> dict:
+    def stats(self) -> dict:
+        """SYNC deliberately: async methods queue behind the
+        max_ongoing_requests semaphore, and the autoscaler must see the
+        true ongoing count exactly when the replica is saturated (sync
+        methods run on the exec thread / thread pool, not the loop)."""
         return {"replica_id": self.replica_id, "ongoing": self.ongoing,
                 "total": self.total}
 
@@ -97,10 +101,13 @@ class Replica:
             await asyncio.sleep(0.02)
         return self.ongoing == 0
 
-    async def health_check(self) -> bool:
+    def health_check(self) -> bool:
+        """SYNC deliberately (see stats): a saturated-but-healthy replica
+        must still answer within the controller's timeout, or it gets
+        evicted exactly when it's doing its job. Process liveness is the
+        primary signal (a dead actor fails the call itself); sync user
+        check_health hooks run inline, async ones are skipped."""
         user_check = getattr(self.callable, "check_health", None)
-        if user_check is not None:
-            out = user_check()
-            if inspect.isawaitable(out):
-                await out
+        if user_check is not None and not inspect.iscoroutinefunction(user_check):
+            user_check()
         return True
