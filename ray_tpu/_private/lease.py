@@ -248,6 +248,23 @@ class LeaseManager:
             res = self.w._resolutions.get(oid)
             if res is not None:
                 res.resolve(inline, [tuple(holder)] if holder else [], error)
+        if lease.cls.strategy.kind == "SPREAD" and not lease.inflight:
+            # SPREAD is a PER-TASK placement decision (reference spread
+            # policy): return the lease after its task so the controller
+            # places the next one fresh — reusing it would funnel a burst
+            # through whichever node connected first.
+            self._retire_lease(lease)
+
+    def _retire_lease(self, lease: _Lease):
+        if lease.dead:
+            return
+        lease.dead = True
+        lease.cls.leases.pop(lease.lease_id, None)
+        self._by_id.pop(lease.lease_id, None)
+        if lease.conn is not None:
+            self._by_conn.pop(lease.conn, None)
+            asyncio.ensure_future(lease.conn.close())
+        asyncio.ensure_future(self._a_return([lease.lease_id]))
 
     def _fail_spec(self, spec: TaskSpec, blob: dict):
         h, bufs = dumps_oob(blob)

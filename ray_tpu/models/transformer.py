@@ -37,6 +37,9 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     dtype: jnp.dtype = jnp.bfloat16  # activation/compute dtype
     param_dtype: jnp.dtype = jnp.float32
+    #: >0 switches the MLP to a top-2 MoE with this many experts, sharded
+    #: over the "ep" mesh axis.
+    moe_experts: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -99,13 +102,54 @@ class SwiGLU(nn.Module):
         return dense(cfg.d_model, "w_down")(gate * up)
 
 
+class MoE(nn.Module):
+    """Top-2 mixture-of-experts SwiGLU, expert-parallel over "ep".
+
+    Expert weights carry a leading [E] axis sharded over the ep mesh axis;
+    each device computes its expert shard over all tokens and the combine
+    contraction reduces over ep (XLA inserts the collective). Dense
+    dispatch (no capacity/dropping) keeps the math exactly equal to the
+    single-device reference — the routing SEMANTICS and the ep sharding are
+    what the dryrun proves; capacity-based all_to_all dispatch is the
+    optimization seam."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        e, dm, ff = cfg.moe_experts, cfg.d_model, cfg.d_ff
+        router = self.param("router", nn.initializers.normal(0.02),
+                            (dm, e), jnp.float32)
+        w_gate = self.param("w_gate", nn.initializers.lecun_normal(),
+                            (e, dm, ff), cfg.param_dtype)
+        w_up = self.param("w_up", nn.initializers.lecun_normal(),
+                          (e, dm, ff), cfg.param_dtype)
+        w_down = self.param("w_down", nn.initializers.lecun_normal(),
+                            (e, ff, dm), cfg.param_dtype)
+        logits = x.astype(jnp.float32) @ router  # [B, S, E]
+        probs = jax.nn.softmax(logits, axis=-1)
+        top2 = jax.lax.top_k(probs, 2)[0][..., -1:]  # 2nd-highest prob
+        gates = jnp.where(probs >= top2, probs, 0.0)
+        gates = gates / jnp.sum(gates, axis=-1, keepdims=True)  # renorm top-2
+        xc = x.astype(cfg.dtype)
+        gate_h = nn.silu(jnp.einsum("bsd,edf->ebsf", xc, w_gate.astype(cfg.dtype)))
+        up_h = jnp.einsum("bsd,edf->ebsf", xc, w_up.astype(cfg.dtype))
+        expert_out = jnp.einsum("ebsf,efd->ebsd", gate_h * up_h,
+                                w_down.astype(cfg.dtype))
+        return jnp.einsum("ebsd,bse->bsd", expert_out,
+                          gates.astype(cfg.dtype))
+
+
 class Block(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
     def __call__(self, x, positions):
         x = x + Attention(self.cfg, name="attn")(RMSNorm(name="attn_norm")(x), positions)
-        x = x + SwiGLU(self.cfg, name="mlp")(RMSNorm(name="mlp_norm")(x))
+        mlp = (MoE(self.cfg, name="moe") if self.cfg.moe_experts
+               else SwiGLU(self.cfg, name="mlp"))
+        x = x + mlp(RMSNorm(name="mlp_norm")(x))
         return x
 
 
@@ -147,9 +191,17 @@ def param_specs(params) -> dict:
     """
 
     def rule(path: tuple[str, ...], leaf):
-        name = path[-2] if len(path) >= 2 else path[-1]
-        if path[-1] == "tok_emb":
+        last = path[-1]
+        name = path[-2] if len(path) >= 2 else last
+        moe = "moe" in path
+        if last == "tok_emb":
             return P("tp", "fsdp")  # vocab over tp, d_model over fsdp
+        if last == "router":
+            return P("fsdp", None)
+        if moe and last in ("w_gate", "w_up"):
+            return P("ep", "fsdp", "tp")  # leading [E] axis over ep
+        if moe and last == "w_down":
+            return P("ep", "tp", "fsdp")
         if name in ("wq", "wk", "wv"):
             return P("fsdp", "tp", None)  # heads over tp
         if name == "wo":

@@ -18,47 +18,32 @@ logger = logging.getLogger(__name__)
 _warned_fallback = False
 
 
-def _pallas_supported(q, k, v) -> bool:
-    from ray_tpu.ops.flash_attention import DEFAULT_BLOCK_K, DEFAULT_BLOCK_Q
-
-    b, sq, hq, d = q.shape
-    _, sk, hkv, _ = k.shape
-    if hq % hkv != 0:
-        return False
-    bq = min(DEFAULT_BLOCK_Q, sq)
-    bk = min(DEFAULT_BLOCK_K, sk)
-    return (sq % bq == 0 and sk % bk == 0
-            and bq % 8 == 0 and bk % 128 == 0)
-
-
 def dot_product_attention(q, k, v, *, causal: bool = True, use_pallas: bool | None = None):
     """q: [B, Sq, Hq, D], k/v: [B, Sk, Hkv, D] (GQA when Hq > Hkv).
 
     Returns [B, Sq, Hq, D]. Softmax in f32 regardless of input dtype
     (bf16-safe), output in the input dtype. Dispatches to the Pallas flash
-    kernel on TPU when shapes allow; the fallback is LOGGED, never silent.
-    """
+    kernel on TPU; every fallback is LOGGED, never silent. The kernel's own
+    ValueError is the single source of truth for shape support (no
+    duplicated predicate to drift)."""
     global _warned_fallback
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
     if use_pallas:
-        if _pallas_supported(q, k, v):
-            from ray_tpu.ops.flash_attention import flash_attention
+        from ray_tpu.ops.flash_attention import flash_attention
 
-            try:
-                return flash_attention(q, k, v, causal=causal)
-            except Exception as e:
-                # Safety net for constraints the predicate can't model
-                # (Mosaic lowering limits, odd head dims, dtypes) — but
-                # LOUD, never silent.
-                logger.warning("flash attention kernel failed (%r); "
-                               "falling back to XLA", e)
-        elif not _warned_fallback:
-            _warned_fallback = True
-            logger.warning(
-                "attention falling back to the XLA path (shapes %s/%s not "
-                "divisible by the flash kernel's blocks); O(Sq*Sk) memory",
-                q.shape, k.shape)
+        try:
+            return flash_attention(q, k, v, causal=causal)
+        except ValueError as e:
+            if not _warned_fallback:
+                _warned_fallback = True
+                logger.warning(
+                    "attention falling back to the XLA path (%s); "
+                    "O(Sq*Sk) memory", e)
+        except Exception as e:
+            # Mosaic lowering limits, odd head dims, dtypes: loud safety net.
+            logger.warning("flash attention kernel failed (%r); "
+                           "falling back to XLA", e)
     return _xla_attention(q, k, v, causal=causal)
 
 

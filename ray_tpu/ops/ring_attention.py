@@ -31,12 +31,12 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = True):
     idx = jax.lax.axis_index(axis_name)
     b, s_local, hq, d = q.shape
     _, _, hkv, _ = k.shape
-    if hq != hkv:
-        rep = hq // hkv
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    rep = hq // hkv
     scale = d ** -0.5
-    qf = q.astype(jnp.float32)
+    # GQA stays folded as a group dim [b, s, hkv, rep, d]: k/v ride the
+    # ring at their NATIVE hkv width (repeating them would multiply every
+    # ppermute transfer and per-device kv residency by hq/hkv).
+    qf = q.astype(jnp.float32).reshape(b, s_local, hkv, rep, d)
 
     q_pos = idx * s_local + jax.lax.broadcasted_iota(
         jnp.int32, (s_local, s_local), 0)
@@ -46,13 +46,13 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = True):
     def step(carry, s):
         m, l, acc, k_cur, v_cur = carry
         owner = (idx - s) % n  # whose keys are visiting this step
-        sc = jnp.einsum("bqhd,bkhd->bhqk", qf, k_cur.astype(jnp.float32),
+        sc = jnp.einsum("bqhgd,bkhd->bhgqk", qf, k_cur.astype(jnp.float32),
                         preferred_element_type=jnp.float32) * scale
         if causal:
             k_pos = owner * s_local + jax.lax.broadcasted_iota(
                 jnp.int32, (s_local, s_local), 1)
             mask = k_pos <= q_pos  # [s_local, s_local] global causal
-            sc = jnp.where(mask[None, None], sc, jnp.float32(-jnp.inf))
+            sc = jnp.where(mask[None, None, None], sc, jnp.float32(-jnp.inf))
         m_cur = jnp.max(sc, axis=-1, keepdims=True)
         m_new = jnp.maximum(m, m_cur)
         # Guard -inf - -inf (rows with no visible keys in this chunk).
@@ -62,14 +62,14 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = True):
         alpha = jnp.where(jnp.isinf(m) & jnp.isinf(m_new), 0.0, alpha)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         acc_new = acc * alpha + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, v_cur.astype(jnp.float32))
+            "bhgqk,bkhd->bhgqd", p, v_cur.astype(jnp.float32))
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
         return (m_new, l_new, acc_new, k_nxt, v_nxt), None
 
-    m0 = jnp.full((b, hq, s_local, 1), -jnp.inf, jnp.float32)
-    l0 = jnp.zeros((b, hq, s_local, 1), jnp.float32)
-    acc0 = jnp.zeros((b, hq, s_local, d), jnp.float32)
+    m0 = jnp.full((b, hkv, rep, s_local, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, hkv, rep, s_local, 1), jnp.float32)
+    acc0 = jnp.zeros((b, hkv, rep, s_local, d), jnp.float32)
     # The outputs vary over the sp axis (they depend on axis_index); the
     # constant initial carries must be marked varying too or scan rejects
     # the carry type under shard_map.
@@ -80,5 +80,5 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = True):
     (m, l, acc, _k, _v), _ = jax.lax.scan(
         step, (m0, l0, acc0, k, v), jnp.arange(n))
     l = jnp.where(l == 0.0, 1.0, l)
-    out = (acc / l).astype(q.dtype)  # [B, H, Sq_local, D]
-    return out.transpose(0, 2, 1, 3)
+    out = (acc / l).astype(q.dtype)  # [B, Hkv, rep, Sq_local, D]
+    return out.transpose(0, 3, 1, 2, 4).reshape(b, s_local, hq, d)

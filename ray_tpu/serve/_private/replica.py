@@ -105,9 +105,17 @@ class Replica:
         """SYNC deliberately (see stats): a saturated-but-healthy replica
         must still answer within the controller's timeout, or it gets
         evicted exactly when it's doing its job. Process liveness is the
-        primary signal (a dead actor fails the call itself); sync user
-        check_health hooks run inline, async ones are skipped."""
+        primary signal (a dead actor fails the call itself). User
+        check_health hooks run inline; awaitable results are driven on a
+        private loop so an async probe still actually executes."""
         user_check = getattr(self.callable, "check_health", None)
-        if user_check is not None and not inspect.iscoroutinefunction(user_check):
-            user_check()
+        if user_check is None:
+            return True
+        out = user_check()
+        if inspect.isawaitable(out):
+            loop = asyncio.new_event_loop()
+            try:
+                loop.run_until_complete(out)
+            finally:
+                loop.close()
         return True
