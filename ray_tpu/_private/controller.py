@@ -730,6 +730,14 @@ class Controller:
             except asyncio.TimeoutError:
                 return {"status": "timeout"}
 
+    async def _h_cluster_info(self, conn, a):
+        """Bootstrap info for joining nodes/CLIs (reference: ray start
+        --address fetches the session from the GCS)."""
+        return {
+            "session": self.session_id,
+            "num_nodes": sum(1 for n in self.nodes.values() if n.alive),
+        }
+
     async def _h_check_objects(self, conn, a):
         """Bulk readiness probe (backs `wait()`, cf. reference WaitManager
         raylet/wait_manager.h)."""

@@ -129,9 +129,10 @@ class MoE(nn.Module):
                             (e, ff, dm), cfg.param_dtype)
         logits = x.astype(jnp.float32) @ router  # [B, S, E]
         probs = jax.nn.softmax(logits, axis=-1)
-        top2 = jax.lax.top_k(probs, 2)[0][..., -1:]  # 2nd-highest prob
-        gates = jnp.where(probs >= top2, probs, 0.0)
-        gates = gates / jnp.sum(gates, axis=-1, keepdims=True)  # renorm top-2
+        k = min(2, e)  # top-2 routing (top-1 when only one expert)
+        kth = jax.lax.top_k(probs, k)[0][..., -1:]  # k-th highest prob
+        gates = jnp.where(probs >= kth, probs, 0.0)
+        gates = gates / jnp.sum(gates, axis=-1, keepdims=True)  # renorm top-k
         xc = x.astype(cfg.dtype)
         gate_h = nn.silu(jnp.einsum("bsd,edf->ebsf", xc, w_gate.astype(cfg.dtype)))
         up_h = jnp.einsum("bsd,edf->ebsf", xc, w_up.astype(cfg.dtype))

@@ -1,0 +1,252 @@
+"""`ray-tpu` command line: start/stop/status for multi-machine clusters.
+
+Parity target: reference python/ray/scripts/scripts.py:706 (`ray start
+--head` / `--address`, `ray stop`, `ray status`). The head runs as a
+detached process (controller + local node agent); joining nodes spawn a
+detached NodeAgent pointed at the head. State lives under --session-dir
+(default /tmp/ray_tpu_<uid>).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def _default_session_dir() -> str:
+    return os.path.join("/tmp", f"ray_tpu_{os.getuid()}")
+
+
+class _Client:
+    """One loop + one registered connection, reused across CLI calls (the
+    join path polls the controller; per-call thread/socket churn would fire
+    the controller's client-reap machinery hundreds of times)."""
+
+    def __init__(self, address: str):
+        from ray_tpu._private import rpc
+
+        self._rpc = rpc
+        self.host, port = address.rsplit(":", 1)
+        self.port = int(port)
+        self.loop = rpc.EventLoopThread(name="ray-tpu-cli")
+        self._conn = None
+
+    def call(self, method: str, timeout: float = 10.0, **kw):
+        async def _go():
+            if self._conn is None or self._conn.closed:
+                self._conn = await self._rpc.connect(
+                    self.host, self.port, timeout=timeout)
+                await self._conn.call("register", kind="client",
+                                      worker_id="ray-tpu-cli", address=None)
+            return await self._conn.call(method, **kw)
+
+        return self.loop.run(_go(), timeout=timeout + 5)
+
+    def close(self):
+        if self._conn is not None:
+            conn, self._conn = self._conn, None
+
+            async def _bye():
+                await conn.close()
+
+            try:
+                self.loop.run(_bye(), timeout=5)
+            except Exception:
+                pass
+        self.loop.stop()
+
+
+def _rpc_call(address: str, method: str, timeout: float = 10.0, **kw):
+    c = _Client(address)
+    try:
+        return c.call(method, timeout=timeout, **kw)
+    finally:
+        c.close()
+
+
+def _wait_for(pred, timeout: float, what: str):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            out = pred()
+            if out:
+                return out
+        except Exception:
+            pass
+        time.sleep(0.2)
+    raise TimeoutError(f"timed out waiting for {what}")
+
+
+def cmd_start(args) -> int:
+    os.makedirs(args.session_dir, exist_ok=True)
+    if args.head:
+        head_file = os.path.join(args.session_dir, "head.json")
+        if os.path.exists(head_file):
+            old = json.load(open(head_file))
+            if _is_ours(old.get("pid", -1)):
+                print(f"head already running (pid {old['pid']}); "
+                      f"run `ray-tpu stop` first", file=sys.stderr)
+                return 1
+            os.unlink(head_file)  # stale file from a crashed head
+        cmd = [sys.executable, "-m", "ray_tpu.scripts.head_main",
+               "--host", args.host, "--port", str(args.port),
+               "--session-dir", args.session_dir,
+               "--resources", args.resources]
+        if args.num_cpus is not None:
+            cmd += ["--num-cpus", str(args.num_cpus)]
+        if args.num_tpus is not None:
+            cmd += ["--num-tpus", str(args.num_tpus)]
+        proc = subprocess.Popen(cmd, start_new_session=True,
+                                stdout=subprocess.DEVNULL,
+                                stderr=subprocess.DEVNULL)
+        info = _wait_for(lambda: (json.load(open(head_file))
+                                  if os.path.exists(head_file) else None),
+                         30, "head startup")
+        _wait_for(lambda: _rpc_call(info["address"], "cluster_info"),
+                  30, "controller")
+        print(f"ray-tpu head started at {info['address']} (pid {proc.pid})")
+        print(f"join other machines with: ray-tpu start --address {info['address']}")
+        return 0
+
+    if not args.address:
+        print("pass --head or --address host:port", file=sys.stderr)
+        return 1
+    info = _rpc_call(args.address, "cluster_info")
+    from ray_tpu._private.ids import NodeID
+    from ray_tpu._private.accelerators import host_resources
+    from ray_tpu._private.resources import ResourceSet
+
+    res = host_resources(args.num_cpus, args.num_tpus)
+    res.update(json.loads(args.resources))
+    node_id = NodeID.from_random().hex()
+    cmd = [sys.executable, "-m", "ray_tpu._private.node_agent",
+           "--controller", args.address,
+           "--node-id", node_id,
+           "--session", info["session"],
+           "--resources", json.dumps(ResourceSet(res).raw()),
+           "--labels", "{}"]
+    proc = subprocess.Popen(cmd, start_new_session=True,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    nodes_file = os.path.join(args.session_dir, "nodes.json")
+    nodes = []
+    if os.path.exists(nodes_file):
+        nodes = json.load(open(nodes_file))
+    nodes.append({"node_id": node_id, "pid": proc.pid})
+    with open(nodes_file, "w") as f:
+        json.dump(nodes, f)
+
+    client = _Client(args.address)
+    try:
+        def _alive():
+            snap = client.call("state_snapshot")
+            ent = snap["nodes"].get(node_id)
+            return ent is not None and ent["alive"]
+
+        _wait_for(_alive, 60, "node registration")
+    finally:
+        client.close()
+    print(f"node {node_id[:8]} joined {args.address} (pid {proc.pid})")
+    return 0
+
+
+def cmd_stop(args) -> int:
+    stopped = 0
+    nodes_file = os.path.join(args.session_dir, "nodes.json")
+    if os.path.exists(nodes_file):
+        for ent in json.load(open(nodes_file)):
+            stopped += _kill(ent["pid"])
+        os.unlink(nodes_file)
+    head_file = os.path.join(args.session_dir, "head.json")
+    if os.path.exists(head_file):
+        stopped += _kill(json.load(open(head_file))["pid"])
+        os.unlink(head_file)
+    print(f"stopped {stopped} process(es)")
+    return 0
+
+
+def _is_ours(pid: int) -> bool:
+    """Never kill a recycled PID: the process must actually be a ray-tpu
+    head/agent (reference `ray stop` matches cmdlines the same way)."""
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            cmdline = f.read().replace(b"\x00", b" ")
+    except OSError:
+        return False
+    return (b"ray_tpu.scripts.head_main" in cmdline
+            or b"ray_tpu._private.node_agent" in cmdline)
+
+
+def _kill(pid: int) -> int:
+    if not _is_ours(pid):
+        return 0
+    try:
+        os.kill(pid, signal.SIGTERM)
+    except ProcessLookupError:
+        return 0
+    for _ in range(50):
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            return 1
+        time.sleep(0.1)
+    try:
+        os.kill(pid, signal.SIGKILL)
+    except ProcessLookupError:
+        pass
+    return 1
+
+
+def cmd_status(args) -> int:
+    address = args.address
+    if not address:
+        head_file = os.path.join(args.session_dir, "head.json")
+        if not os.path.exists(head_file):
+            print("no head recorded; pass --address", file=sys.stderr)
+            return 1
+        address = json.load(open(head_file))["address"]
+    snap = _rpc_call(address, "state_snapshot")
+    info = _rpc_call(address, "cluster_info")
+    print(f"cluster {address} (session {info['session'][:8]})")
+    for nid, n in snap["nodes"].items():
+        state = "ALIVE" if n["alive"] else "DEAD"
+        print(f"  node {nid[:8]} {state} total={n['total']} available={n['available']}")
+    actors = snap.get("actors", {})
+    alive_actors = sum(1 for a in actors.values() if a.get("state") != "DEAD")
+    print(f"  actors: {alive_actors}  pending tasks: {snap.get('pending_tasks', 0)}")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="ray-tpu")
+    p.add_argument("--session-dir", default=_default_session_dir())
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    ps = sub.add_parser("start", help="start a head or join a cluster")
+    ps.add_argument("--head", action="store_true")
+    ps.add_argument("--address", default=None, help="head host:port to join")
+    ps.add_argument("--host", default="127.0.0.1")
+    ps.add_argument("--port", type=int, default=6380)
+    ps.add_argument("--num-cpus", type=float, default=None)
+    ps.add_argument("--num-tpus", type=float, default=None)
+    ps.add_argument("--resources", default="{}")
+    ps.set_defaults(fn=cmd_start)
+
+    pq = sub.add_parser("stop", help="stop processes started on this machine")
+    pq.set_defaults(fn=cmd_stop)
+
+    pt = sub.add_parser("status", help="print cluster state")
+    pt.add_argument("--address", default=None)
+    pt.set_defaults(fn=cmd_status)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

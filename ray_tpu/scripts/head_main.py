@@ -1,0 +1,51 @@
+"""Detached head process: controller + local node agent.
+
+Spawned by `ray-tpu start --head` (ray_tpu/scripts/cli.py); runs until
+SIGTERM/SIGINT. Writes the session file the CLI and joining nodes read.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=6380)
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.add_argument("--num-tpus", type=float, default=None)
+    p.add_argument("--resources", default="{}")
+    p.add_argument("--session-dir", required=True)
+    args = p.parse_args()
+
+    from ray_tpu._private.bootstrap import HeadNode
+
+    head = HeadNode(num_cpus=args.num_cpus, num_tpus=args.num_tpus,
+                    resources=json.loads(args.resources),
+                    host=args.host, port=args.port)
+    addr = head.start()
+    os.makedirs(args.session_dir, exist_ok=True)
+    with open(os.path.join(args.session_dir, "head.json"), "w") as f:
+        json.dump({"address": f"{addr[0]}:{addr[1]}", "pid": os.getpid(),
+                   "session": head.session_id}, f)
+    print(f"ray-tpu head up at {addr[0]}:{addr[1]}", flush=True)
+
+    stop = threading.Event()
+
+    def _term(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    stop.wait()
+    head.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -116,6 +116,16 @@ class Replica:
             loop = asyncio.new_event_loop()
             try:
                 loop.run_until_complete(out)
+            except RuntimeError as e:
+                # The hook touched serving-loop-bound state (locks,
+                # sessions): loop affinity failing here proves nothing
+                # about health — process liveness already did the real
+                # check. Never evict a replica over it.
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "async check_health could not run on a private loop "
+                    "(%r); treating as healthy", e)
             finally:
                 loop.close()
         return True

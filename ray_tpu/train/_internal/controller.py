@@ -86,6 +86,8 @@ class TrainController:
                     restart_index=attempt,
                     latest_checkpoint=self.latest_checkpoint,
                     dataset_shards_per_worker=self._split_datasets(),
+                    jax_distributed=self.scaling.jax_distributed,
+                    worker_env=self.scaling.worker_env,
                 )
             except Exception as e:
                 # Group start failure goes through the same failure policy

@@ -25,7 +25,8 @@ class TrainWorkerActor:
 
     def setup(self, *, rank: int, world_size: int, local_rank: int, node_rank: int,
               run_name: str, storage_dir: str, restart_index: int,
-              latest_checkpoint, group_name: str, dataset_shards=None):
+              latest_checkpoint, group_name: str, dataset_shards=None,
+              jax_distributed: bool = False):
         session_mod.init_session(
             rank=rank, world_size=world_size, local_rank=local_rank,
             node_rank=node_rank, run_name=run_name, storage_dir=storage_dir,
@@ -38,6 +39,13 @@ class TrainWorkerActor:
         from ray_tpu.util import collective
 
         collective.init_collective_group(world_size, rank, group_name)
+        if jax_distributed:
+            # One global jax mesh over every worker's devices: rank 0 hosts
+            # the coordinator; the address rendezvous rides the controller
+            # KV (role of the reference's torch dist init_method).
+            from ray_tpu.train import jax_utils
+
+            jax_utils.setup_jax_distributed(group_name, rank, world_size)
         return True
 
     def run(self, train_fn, config):
@@ -68,7 +76,9 @@ class WorkerGroup:
     def __init__(self, *, num_workers: int, resources_per_worker: dict,
                  run_name: str, storage_dir: str, group_name: str,
                  restart_index: int = 0, latest_checkpoint=None,
-                 dataset_shards_per_worker: Optional[list] = None):
+                 dataset_shards_per_worker: Optional[list] = None,
+                 jax_distributed: bool = False,
+                 worker_env: Optional[dict] = None):
         self.num_workers = num_workers
         self.workers = []
         res = dict(resources_per_worker)
@@ -77,6 +87,10 @@ class WorkerGroup:
             opts["num_tpus"] = resources_per_worker["TPU"]
         if res:
             opts["resources"] = res
+        if worker_env:
+            # Applied at worker-process spawn, BEFORE any import runs there
+            # (XLA_FLAGS etc. must precede the first jax import).
+            opts["runtime_env"] = {"env_vars": dict(worker_env)}
         try:
             for rank in range(num_workers):
                 self.workers.append(TrainWorkerActor.options(**opts).remote())
@@ -88,7 +102,8 @@ class WorkerGroup:
                     rank=rank, world_size=num_workers, local_rank=rank,
                     node_rank=0, run_name=run_name, storage_dir=storage_dir,
                     restart_index=restart_index, latest_checkpoint=latest_checkpoint,
-                    group_name=group_name, dataset_shards=shards))
+                    group_name=group_name, dataset_shards=shards,
+                    jax_distributed=jax_distributed))
             ray_tpu.get(setup_refs, timeout=300)
         except BaseException:
             # A failed start must not strand the actors it already created.

@@ -24,6 +24,16 @@ class ScalingConfig:
     #: TPU slice topology hint, e.g. "v5e-8" (scheduling label; reference
     #: TPUAcceleratorManager pod awareness, accelerators/tpu.py:312).
     topology: Optional[str] = None
+    #: Form ONE global jax mesh across all workers via
+    #: jax.distributed.initialize (rank 0 hosts the coordinator; the
+    #: address rendezvous rides the controller KV). On a real multi-host
+    #: TPU slice this is how the per-host processes become one GSPMD
+    #: program over ICI/DCN.
+    jax_distributed: bool = False
+    #: Extra env vars for worker processes, applied BEFORE any import in
+    #: the worker (e.g. XLA_FLAGS=--xla_force_host_platform_device_count=4
+    #: to give each worker a virtual device mesh in tests).
+    worker_env: Optional[dict] = None
 
     def worker_resources(self) -> dict:
         if self.resources_per_worker is not None:

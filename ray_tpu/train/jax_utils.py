@@ -53,6 +53,46 @@ def broadcast_params(params, group_name: str | None = None, src_rank: int = 0):
     return collective.broadcast(host, src_rank=src_rank, group_name=group_name)
 
 
+def setup_jax_distributed(group_name: str, rank: int, world_size: int,
+                          timeout_s: float = 60.0):
+    """Join all train workers into ONE jax process group: rank 0 reserves a
+    coordinator port and publishes it through the controller KV; everyone
+    calls jax.distributed.initialize. After this, jax.devices() spans every
+    worker's chips and global_mesh_from_distributed builds the slice-wide
+    mesh (reference role: torch.distributed init_method rendezvous)."""
+    import socket
+    import time
+
+    from ray_tpu._private.worker import global_worker
+
+    w = global_worker()
+    key = f"jaxdist/{group_name}/coordinator"
+    if rank == 0:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()  # race-prone in theory; jax rebinds immediately
+        # Workers bind loopback in this runtime; on a real multi-host
+        # deployment the node agent's host IP takes this seat.
+        host = w.server_addr[0] if w.server_addr else "127.0.0.1"
+        addr = f"{host}:{port}"
+        w.kv("put", ns="train", key=key, value=addr.encode())
+    else:
+        deadline = time.monotonic() + timeout_s
+        addr = None
+        while time.monotonic() < deadline:
+            v = w.kv("get", ns="train", key=key)["value"]
+            if v is not None:
+                addr = bytes(v).decode()
+                break
+            time.sleep(0.05)
+        if addr is None:
+            raise TimeoutError("jax.distributed coordinator rendezvous timed out")
+    jax.distributed.initialize(coordinator_address=addr,
+                               num_processes=world_size, process_id=rank)
+    return addr
+
+
 def global_mesh_from_distributed(axis_names=("dp",), shape=None):
     """Multi-host path: after jax.distributed.initialize on every worker,
     build one mesh over ALL processes' devices (reference role:

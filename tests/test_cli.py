@@ -1,0 +1,54 @@
+"""ray-tpu CLI: start --head / start --address / status / stop.
+
+reference tests: python/ray/tests/test_cli.py (ray start/stop paths).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import ray_tpu
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cli(session_dir, *args, timeout=120):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli",
+         "--session-dir", str(session_dir), *args],
+        capture_output=True, text=True, timeout=timeout, env=env)
+
+
+def test_cli_cluster_lifecycle(tmp_path, shutdown_only):
+    sdir = tmp_path / "session"
+    try:
+        r = _cli(sdir, "start", "--head", "--num-cpus", "1", "--port", "0")
+        assert r.returncode == 0, r.stderr
+        info = json.load(open(sdir / "head.json"))
+
+        r = _cli(sdir, "start", "--address", info["address"], "--num-cpus", "2")
+        assert r.returncode == 0, r.stderr
+
+        r = _cli(sdir, "status")
+        assert r.returncode == 0, r.stderr
+        assert r.stdout.count("ALIVE") == 2, r.stdout
+
+        # A driver connects and runs work on BOTH CLI-started nodes.
+        ray_tpu.init(address=info["address"])
+
+        @ray_tpu.remote(scheduling_strategy="SPREAD")
+        def where():
+            return os.environ.get("RT_NODE_ID")
+
+        nodes = set(ray_tpu.get([where.remote() for _ in range(6)], timeout=120))
+        assert len(nodes) == 2
+        ray_tpu.shutdown()
+    finally:
+        r = _cli(sdir, "stop")
+    assert "stopped" in r.stdout
+    assert not (sdir / "head.json").exists()

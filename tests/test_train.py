@@ -129,3 +129,44 @@ def test_jax_trainer_user_error_no_retry(ray_start_2cpu, tmp_path):
     )
     result = trainer.fit()
     assert result.error is not None and "intentional" in result.error
+
+
+def test_jax_distributed_global_mesh(ray_start_4cpu, tmp_path):
+    """ScalingConfig(jax_distributed=True): 2 worker processes x 4 virtual
+    CPU devices each form ONE 8-device global mesh via
+    jax.distributed.initialize (coordinator rendezvous over the controller
+    KV), and a psum over the global mesh sees every device."""
+
+    def loop(config):
+        import jax
+        import jax.numpy as jnp
+
+        import ray_tpu.train as train
+        from ray_tpu.train.jax_utils import global_mesh_from_distributed
+
+        assert jax.process_count() == 2
+        assert len(jax.devices()) == 8, jax.devices()
+        mesh = global_mesh_from_distributed(axis_names=("dp",))
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        ones = jnp.ones((8, 4))
+        sharded = jax.device_put(ones, NamedSharding(mesh, P("dp")))
+        total = float(jax.jit(
+            lambda x: jnp.sum(x),
+            in_shardings=(NamedSharding(mesh, P("dp")),))(sharded))
+        train.report({"total": total,
+                      "devices": len(jax.devices()),
+                      "procs": jax.process_count()})
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(
+            num_workers=2, jax_distributed=True,
+            worker_env={"XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+                        "JAX_PLATFORMS": "cpu"}),
+        run_config=RunConfig(storage_path=str(tmp_path)))
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert result.metrics["devices"] == 8
+    assert result.metrics["procs"] == 2
+    assert result.metrics["total"] == 32.0
