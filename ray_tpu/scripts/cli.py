@@ -69,9 +69,14 @@ def _rpc_call(address: str, method: str, timeout: float = 10.0, **kw):
         c.close()
 
 
-def _wait_for(pred, timeout: float, what: str):
+def _wait_for(pred, timeout: float, what: str, proc=None, log_file=None):
+    """Poll pred; fail FAST (with the child's log tail) if proc died."""
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
+        if proc is not None and proc.poll() is not None:
+            raise RuntimeError(
+                f"{what}: process exited with code {proc.returncode}"
+                + _log_tail(log_file))
         try:
             out = pred()
             if out:
@@ -79,7 +84,27 @@ def _wait_for(pred, timeout: float, what: str):
         except Exception:
             pass
         time.sleep(0.2)
-    raise TimeoutError(f"timed out waiting for {what}")
+    raise TimeoutError(f"timed out waiting for {what}" + _log_tail(log_file))
+
+
+def _log_tail(log_file) -> str:
+    if not log_file or not os.path.exists(log_file):
+        return ""
+    try:
+        with open(log_file) as f:
+            tail = f.read()[-2000:]
+        return f"\n--- {log_file} ---\n{tail}" if tail.strip() else ""
+    except OSError:
+        return ""
+
+
+def _spawn_logged(cmd, session_dir: str, name: str):
+    log_path = os.path.join(session_dir, f"{name}.log")
+    log = open(log_path, "ab")
+    proc = subprocess.Popen(cmd, start_new_session=True,
+                            stdout=log, stderr=subprocess.STDOUT)
+    log.close()
+    return proc, log_path
 
 
 def cmd_start(args) -> int:
@@ -101,14 +126,12 @@ def cmd_start(args) -> int:
             cmd += ["--num-cpus", str(args.num_cpus)]
         if args.num_tpus is not None:
             cmd += ["--num-tpus", str(args.num_tpus)]
-        proc = subprocess.Popen(cmd, start_new_session=True,
-                                stdout=subprocess.DEVNULL,
-                                stderr=subprocess.DEVNULL)
+        proc, log_path = _spawn_logged(cmd, args.session_dir, "head")
         info = _wait_for(lambda: (json.load(open(head_file))
                                   if os.path.exists(head_file) else None),
-                         30, "head startup")
+                         30, "head startup", proc=proc, log_file=log_path)
         _wait_for(lambda: _rpc_call(info["address"], "cluster_info"),
-                  30, "controller")
+                  30, "controller", proc=proc, log_file=log_path)
         print(f"ray-tpu head started at {info['address']} (pid {proc.pid})")
         print(f"join other machines with: ray-tpu start --address {info['address']}")
         return 0
@@ -130,9 +153,8 @@ def cmd_start(args) -> int:
            "--session", info["session"],
            "--resources", json.dumps(ResourceSet(res).raw()),
            "--labels", "{}"]
-    proc = subprocess.Popen(cmd, start_new_session=True,
-                            stdout=subprocess.DEVNULL,
-                            stderr=subprocess.DEVNULL)
+    proc, log_path = _spawn_logged(cmd, args.session_dir,
+                                   f"node-{node_id[:8]}")
     nodes_file = os.path.join(args.session_dir, "nodes.json")
     nodes = []
     if os.path.exists(nodes_file):
@@ -148,7 +170,8 @@ def cmd_start(args) -> int:
             ent = snap["nodes"].get(node_id)
             return ent is not None and ent["alive"]
 
-        _wait_for(_alive, 60, "node registration")
+        _wait_for(_alive, 60, "node registration", proc=proc,
+                  log_file=log_path)
     finally:
         client.close()
     print(f"node {node_id[:8]} joined {args.address} (pid {proc.pid})")

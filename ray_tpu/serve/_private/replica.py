@@ -117,10 +117,13 @@ class Replica:
             try:
                 loop.run_until_complete(out)
             except RuntimeError as e:
-                # The hook touched serving-loop-bound state (locks,
-                # sessions): loop affinity failing here proves nothing
-                # about health — process liveness already did the real
-                # check. Never evict a replica over it.
+                msg = str(e)
+                if not ("different loop" in msg or "Event loop is closed" in msg
+                        or "attached to a different" in msg):
+                    raise  # a real user health failure must evict
+                # Loop-affinity only (the hook touched serving-loop-bound
+                # state): proves nothing about health — process liveness
+                # already did the real check. Never evict over it.
                 import logging
 
                 logging.getLogger(__name__).warning(

@@ -1,0 +1,94 @@
+"""Object-plane fault tolerance: lineage reconstruction, spill/restore,
+chaos under a mixed workload.
+
+reference tests: python/ray/tests/test_reconstruction.py,
+test_object_spilling.py, and the ResourceKillerActor chaos pattern
+(python/ray/_private/test_utils.py:1386).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.util.scheduling_strategies import NodeAffinitySchedulingStrategy
+
+
+def test_lineage_reconstruction_node_death(ray_start_cluster):
+    """Kill the only node holding a non-inline result: get() must re-run
+    the producing task elsewhere (reference test_reconstruction.py)."""
+    cluster = ray_start_cluster
+    n2 = cluster.add_node(num_cpus=2)
+    ray_tpu.init(address=cluster.address)
+
+    @ray_tpu.remote(max_retries=2, scheduling_strategy=NodeAffinitySchedulingStrategy(
+        node_id=n2.node_id, soft=True))
+    def produce():
+        import os
+
+        # 2MB: far beyond the inline threshold -> lives in node shm
+        return {"node": os.environ.get("RT_NODE_ID"),
+                "data": np.full(1 << 19, 7, dtype=np.float32)}
+
+    ref = produce.remote()
+    done, _ = ray_tpu.wait([ref], num_returns=1, timeout=120)
+    assert done, "produce() never finished"
+    # Do NOT get() first: the driver must not hold a local copy.
+    cluster.remove_node(n2)
+    out = ray_tpu.get(ref, timeout=120)
+    assert float(out["data"].sum()) == 7.0 * (1 << 19)
+
+
+def test_spill_and_restore_over_capacity(shutdown_only, tmp_path):
+    """Puts beyond object_store_memory_bytes spill to disk and read back
+    intact (reference test_object_spilling.py)."""
+    ray_tpu.init(num_cpus=2, _system_config={
+        "object_store_memory_bytes": 4 * 1024 * 1024,
+        "object_spill_dir": str(tmp_path / "spill"),
+    })
+    arrays = [np.full(1 << 18, i, dtype=np.float32) for i in range(10)]  # 10MB total
+    refs = [ray_tpu.put(a) for a in arrays]
+    for i, r in enumerate(refs):  # oldest were spilled; all must restore
+        got = ray_tpu.get(r, timeout=60)
+        assert float(got[0]) == float(i)
+        assert got.shape == (1 << 18,)
+
+
+def test_chaos_mixed_workload(ray_start_cluster):
+    """NodeKiller cycles nodes while retried tasks + an actor keep working;
+    the workload completes correctly despite the churn."""
+    from ray_tpu.util.chaos import NodeKiller
+
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    cluster.add_node(num_cpus=2)
+    ray_tpu.init(address=cluster.address)
+
+    @ray_tpu.remote(max_retries=8)
+    def flaky_sum(i):
+        time.sleep(0.25)
+        return i * 2
+
+    @ray_tpu.remote(max_restarts=8, max_task_retries=8,
+                    scheduling_strategy=NodeAffinitySchedulingStrategy(
+                        node_id=cluster.head.node_id, soft=False))
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+    counter = Counter.remote()
+    killer = NodeKiller(cluster, interval_s=0.5, max_kills=2,
+                        node_resources={"num_cpus": 2}).start()
+    try:
+        refs = [flaky_sum.remote(i) for i in range(40)]
+        out = ray_tpu.get(refs, timeout=240)
+        assert out == [i * 2 for i in range(40)]
+        assert ray_tpu.get(counter.bump.remote(), timeout=60) == 1
+    finally:
+        killer.stop()
+    assert killer.kills >= 1, "chaos killer never fired"
