@@ -40,6 +40,7 @@ def init(
     namespace: str = "default",
     runtime_env: dict | None = None,
     ignore_reinit_error: bool = False,
+    log_to_driver: bool = True,
     _system_config: dict | None = None,
     _worker_env: dict | None = None,
 ):
@@ -80,6 +81,11 @@ def init(
             w.session_id = rep["session_id"]
             w.store.session = rep["session_id"][:8]
         w.namespace = namespace
+        if log_to_driver:
+            try:
+                w.io.run(w.controller.call("subscribe_logs", on=True), timeout=10)
+            except Exception:
+                pass
         set_global_worker(w)
         atexit.register(shutdown)
         return w
@@ -177,10 +183,46 @@ def nodes() -> list[dict]:
     ]
 
 
-def timeline() -> list[dict]:
-    """Task-event timeline (reference ray.timeline(), _private/state.py:965).
-    Round 1: returns the controller's state snapshot; chrome-trace export TBD."""
-    return _require_worker().state_snapshot()
+def timeline(filename: str | None = None) -> list[dict]:
+    """Chrome-trace task timeline (reference ray.timeline(),
+    _private/state.py:965): complete "X" events per task execution plus
+    process/thread name metadata — opens directly in Perfetto /
+    chrome://tracing. Pass filename to also write the JSON file."""
+    w = _require_worker()
+    rep = w.io.run(w.controller.call("get_task_events"), timeout=30)
+    events = rep["events"]
+    node_pid: dict[str, int] = {}
+    trace: list[dict] = []
+    seen_threads: set[tuple[int, int]] = set()
+    for ev in events:
+        pid = node_pid.setdefault(ev["node_id"], len(node_pid) + 1)
+        tid = int(ev["pid"])
+        if (pid, 0) not in seen_threads:
+            seen_threads.add((pid, 0))
+            trace.append({"ph": "M", "name": "process_name", "pid": pid,
+                          "args": {"name": f"node {ev['node_id'][:8]}"}})
+        if (pid, tid) not in seen_threads:
+            seen_threads.add((pid, tid))
+            trace.append({"ph": "M", "name": "thread_name", "pid": pid,
+                          "tid": tid,
+                          "args": {"name": f"worker {ev['worker_id'][:8]}"}})
+        trace.append({
+            "ph": "X",
+            "name": ev["name"],
+            "cat": ev["kind"],
+            "pid": pid,
+            "tid": tid,
+            "ts": ev["start"] * 1e6,
+            "dur": max(1.0, (ev["end"] - ev["start"]) * 1e6),
+            "args": {"task_id": ev["task_id"], "attempt": ev["attempt"],
+                     "ok": ev["ok"]},
+        })
+    if filename:
+        import json as _json
+
+        with open(filename, "w") as f:
+            _json.dump(trace, f)
+    return trace
 
 
 __all__ = [

@@ -91,6 +91,9 @@ class Controller:
         # resurrect directory entries (see _p_free_objects)
         self.freed_tombstones: dict[str, float] = {}
         self._tombstone_prune_at = 0.0
+        # Task-event ring (reference task_event_buffer.h -> GCS task
+        # events): feeds ray_tpu.timeline() and the state list APIs.
+        self.task_events: deque = deque(maxlen=100_000)
         self.pending: deque[TaskSpec] = deque()
         # task_id -> {"spec", "node_id", "worker_id"}
         self.dispatched: dict[str, dict] = {}
@@ -180,7 +183,8 @@ class Controller:
             wid = a["worker_id"]
             self.client_conns[wid] = conn
             conn.meta.update(kind="client", worker_id=wid, address=tuple(a["address"]) if a.get("address") else None)
-        return {"session_id": self.session_id, "config": CONFIG.snapshot()}
+        return {"session_id": self.session_id, "config": CONFIG.snapshot(),
+                "log_sub": self._any_log_sub()}
 
     async def _p_heartbeat(self, conn, a):
         node = self.nodes.get(a["node_id"])
@@ -729,6 +733,81 @@ class Controller:
                 await asyncio.wait_for(fut, remaining)
             except asyncio.TimeoutError:
                 return {"status": "timeout"}
+
+    # -------------------------------------------------------- observability
+    async def _p_task_events(self, conn, a):
+        self.task_events.extend(a["events"])
+
+    async def _h_get_task_events(self, conn, a):
+        limit = int(a.get("limit", 100_000))
+        evs = list(self.task_events)
+        return {"events": evs[-limit:]}
+
+    async def _h_list_tasks(self, conn, a):
+        """Latest state per task (reference util/state/api.py list_tasks):
+        executed tasks from the event ring + queued/dispatched live ones."""
+        limit = int(a.get("limit", 1000))
+        out: dict[str, dict] = {}
+        for ev in self.task_events:
+            out[ev["task_id"]] = {
+                "task_id": ev["task_id"], "name": ev["name"],
+                "kind": ev["kind"], "attempt": ev["attempt"],
+                "state": "FINISHED" if ev["ok"] else "FAILED",
+                "node_id": ev["node_id"], "worker_id": ev["worker_id"],
+                "start": ev["start"], "end": ev["end"],
+            }
+        for spec in self.pending:
+            out[spec.task_id] = {"task_id": spec.task_id, "name": spec.name,
+                                 "kind": spec.kind, "attempt": spec.attempt,
+                                 "state": "PENDING", "node_id": None,
+                                 "worker_id": None, "start": None, "end": None}
+        for tid, info in self.dispatched.items():
+            out[tid] = {"task_id": tid, "name": info["spec"].name,
+                        "kind": info["spec"].kind,
+                        "attempt": info["spec"].attempt, "state": "RUNNING",
+                        "node_id": info["node_id"],
+                        "worker_id": info["worker_id"],
+                        "start": None, "end": None}
+        return {"tasks": list(out.values())[-limit:]}
+
+    async def _h_list_objects(self, conn, a):
+        limit = int(a.get("limit", 1000))
+        out = []
+        for oid, ent in self.objects.items():
+            out.append({"object_id": oid, "state": ent.state,
+                        "size": ent.size, "owner": ent.owner,
+                        "inline": ent.inline is not None,
+                        "holders": [list(h) for h in ent.holders]})
+            if len(out) >= limit:
+                break
+        return {"objects": out}
+
+    async def _p_worker_logs(self, conn, a):
+        """Fan worker stdout/stderr lines out to subscribed drivers
+        (reference log_monitor.py -> GCS pubsub -> driver printer)."""
+        for c in list(self.client_conns.values()):
+            if c.meta.get("log_sub") and not c.closed and c is not conn:
+                try:
+                    await c.push("worker_log", **a)
+                except Exception:
+                    pass
+
+    def _any_log_sub(self) -> bool:
+        return any(c.meta.get("log_sub") and not c.closed
+                   for c in self.client_conns.values())
+
+    async def _h_subscribe_logs(self, conn, a):
+        conn.meta["log_sub"] = bool(a.get("on", True))
+        # Tell agents whether anyone is listening: unsubscribed clusters
+        # must not pay per-line shipping costs.
+        on = self._any_log_sub()
+        for nconn in self.node_conns.values():
+            if not nconn.closed:
+                try:
+                    await nconn.push("log_sub_state", on=on)
+                except Exception:
+                    pass
+        return {}
 
     async def _h_cluster_info(self, conn, a):
         """Bootstrap info for joining nodes/CLIs (reference: ray start

@@ -89,6 +89,7 @@ class NodeAgent:
             labels=self.labels,
         )
         CONFIG.load_snapshot(rep["config"])
+        self.logs_enabled = bool(rep.get("log_sub", False))
         self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._tasks.append(asyncio.ensure_future(self._reap_loop()))
         if CONFIG.prestart_workers and self.resources_raw.get("CPU", 0) > 0:
@@ -139,6 +140,8 @@ class NodeAgent:
                     await slot.conn.push("cancel", task_id=a["task_id"])
                 except Exception:
                     pass
+        elif method == "log_sub_state":
+            self.logs_enabled = bool(a.get("on", False))
         elif method == "shutdown":
             await self.stop()
 
@@ -281,15 +284,77 @@ class NodeAgent:
         if runtime_env and dedicated:
             for k, v in (runtime_env.get("env_vars") or {}).items():
                 env[k] = str(v)
+        # Capture worker output and stream it to the driver via the
+        # controller (reference log_monitor.py role): one reader thread per
+        # worker into a bounded shared buffer, one timed flusher for all.
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker_proc"],
             env=env,
-            stdout=None,
-            stderr=None,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
         )
+        import threading
+
+        self._ensure_log_flusher()
+        threading.Thread(target=self._pump_worker_logs, args=(wid, proc),
+                         daemon=True, name=f"logs-{wid[:6]}").start()
         slot = _WorkerSlot(wid, proc, dedicated=dedicated)
         self.workers[wid] = slot
         return slot
+
+    MAX_LOG_BUF_LINES = 1000
+
+    def _ensure_log_flusher(self):
+        import threading
+
+        if getattr(self, "_log_flusher", None) is None:
+            self._log_bufs: dict = {}  # wid -> [pid, [lines]]
+            self._log_lock = threading.Lock()
+            self._log_flusher = threading.Thread(
+                target=self._log_flush_loop, daemon=True, name="log-flush")
+            self._log_flusher.start()
+
+    def _pump_worker_logs(self, wid: str, proc):
+        """Reader thread: drain the pipe (ALWAYS — a full pipe blocks the
+        worker) into the bounded shared buffer; the flusher ships it."""
+        try:
+            for raw in iter(proc.stdout.readline, b""):
+                line = raw.decode("utf-8", "replace").rstrip("\n")
+                with self._log_lock:
+                    ent = self._log_bufs.setdefault(wid, [proc.pid, []])
+                    ent[1].append(line)
+                    if len(ent[1]) > self.MAX_LOG_BUF_LINES:
+                        del ent[1][: len(ent[1]) - self.MAX_LOG_BUF_LINES]
+        except Exception:
+            pass
+        finally:
+            try:
+                proc.stdout.close()
+            except Exception:
+                pass
+
+    def _log_flush_loop(self):
+        """Timed flush (100ms): the last line of a burst must not wait for
+        the NEXT line. Lines are dropped (bounded buffer) rather than
+        shipped when no driver subscribed or the controller is away."""
+        import time as _time
+
+        while True:
+            _time.sleep(0.1)
+            with self._log_lock:
+                batches, self._log_bufs = self._log_bufs, {}
+            if not batches:
+                continue
+            if (not getattr(self, "logs_enabled", False)
+                    or self.controller is None or self.controller.closed):
+                continue  # nobody is listening: drop, don't accumulate
+            for wid, (pid, lines) in batches.items():
+                try:
+                    self.controller.push_threadsafe(
+                        "worker_logs", worker_id=wid, pid=pid,
+                        node_id=self.node_id, lines=lines)
+                except Exception:
+                    pass
 
     def _kill_slot(self, slot: _WorkerSlot):
         slot.state = "dead"

@@ -320,6 +320,14 @@ class Worker:
         elif method == "object_ready":
             res = self._resolutions.setdefault(a["oid"], _Resolution())
             res.resolve(a.get("inline"), [tuple(h) for h in a.get("holders", [])], a.get("error"))
+        elif method == "worker_log":
+            # Streamed worker stdout/stderr (reference log_monitor ->
+            # driver printer, "(pid=...) ..." prefixes).
+            import sys as _sys
+
+            prefix = f"({a.get('pid')}, {a.get('node_id', '')[:8]})"
+            for line in a.get("lines", []):
+                print(f"{prefix} {line}", file=_sys.stderr)
         elif method == "object_lost":
             # All copies died with a node. Reconstruct from lineage if we can
             # (reference object_recovery_manager.cc:26), else fail waiters.

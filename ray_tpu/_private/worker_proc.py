@@ -20,6 +20,7 @@ import os
 import queue
 import sys
 import threading
+import time
 import traceback
 
 from ray_tpu._private import rpc
@@ -115,6 +116,10 @@ class WorkerProc:
         self.worker.server_close_handler = lambda conn: self._done_pushers.pop(conn, None)
         self._advertise_pusher = _BatchPusher(
             self.worker.controller, "register_puts", "items")
+        # Task events -> controller (reference task_event_buffer.h role):
+        # one-way batched frames feeding the timeline + state APIs.
+        self._event_pusher = _BatchPusher(
+            self.worker.controller, "task_events", "events")
 
         async def _join_agent():
             self.agent_conn = await rpc.connect(
@@ -264,12 +269,14 @@ class WorkerProc:
         async with self._actor_sem:
             error_blob = None
             value = None
+            t0 = time.time()
             try:
                 method = getattr(self.actor_instance, spec.method_name)
                 args, kwargs = self.worker.decode_args(spec.args, spec.kwargs)
                 value = await method(*args, **kwargs)
             except BaseException as e:  # noqa: BLE001
                 error_blob = self._make_error_blob(spec, e)
+            self._record_event(spec, t0, time.time(), error_blob is None)
             return self._finish_actor_task(spec, value, error_blob)
 
     def _reply_value(self, reply_slot, reply: dict):
@@ -281,6 +288,21 @@ class WorkerProc:
         except BaseException as e:  # executor infrastructure failure
             reply = {"results": [], "error": None, "exec_failure": str(e)}
         self._reply_value(reply_slot, reply)
+
+    def _record_event(self, spec: TaskSpec, start: float, end: float,
+                      ok: bool):
+        """Buffer one execution event (batched to the controller; feeds
+        ray_tpu.timeline() and the state list APIs)."""
+        try:
+            self._event_pusher.add({
+                "task_id": spec.task_id, "name": spec.name,
+                "kind": spec.kind, "attempt": spec.attempt,
+                "start": start, "end": end, "ok": ok,
+                "worker_id": self.worker_id, "node_id": self.node_id,
+                "pid": os.getpid(),
+            })
+        except Exception:
+            pass  # observability must never break execution
 
     # ---------------------------------------------------------- execution
     def _package_results(self, spec: TaskSpec, value, error_blob):
@@ -397,6 +419,7 @@ class WorkerProc:
             saved_env[k] = os.environ.get(k)
             os.environ[k] = str(v)
         self._current_task_id = spec.task_id
+        t0 = time.time()
         try:
             if spec.task_id in self._cancel_requested:
                 self._cancel_requested.discard(spec.task_id)
@@ -418,6 +441,7 @@ class WorkerProc:
                 logger.error("actor __init__ failed:\n%s", traceback.format_exc())
         finally:
             self._current_task_id = None
+            self._record_event(spec, t0, time.time(), error_blob is None)
             if spec.kind != ACTOR_CREATE:  # dedicated actor procs keep their env
                 for k, old in saved_env.items():
                     if old is None:
@@ -467,6 +491,7 @@ class WorkerProc:
             saved_env[k] = os.environ.get(k)
             os.environ[k] = str(v)
         self._current_task_id = spec.task_id
+        t0 = time.time()
         try:
             if spec.task_id in self._cancel_requested:
                 self._cancel_requested.discard(spec.task_id)
@@ -479,6 +504,7 @@ class WorkerProc:
             retryable = self._exception_retryable(spec, e)
         finally:
             self._current_task_id = None
+            self._record_event(spec, t0, time.time(), error_blob is None)
             for k, old in saved_env.items():
                 if old is None:
                     os.environ.pop(k, None)
@@ -521,6 +547,7 @@ class WorkerProc:
     def _execute_actor_task(self, spec: TaskSpec) -> dict:
         error_blob = None
         value = None
+        t0 = time.time()
         try:
             if self.actor_instance is None:
                 raise RuntimeError("actor instance not initialized")
@@ -529,6 +556,7 @@ class WorkerProc:
             value = method(*args, **kwargs)
         except BaseException as e:  # noqa: BLE001
             error_blob = self._make_error_blob(spec, e)
+        self._record_event(spec, t0, time.time(), error_blob is None)
         return self._finish_actor_task(spec, value, error_blob)
 
     def _finish_actor_task(self, spec: TaskSpec, value, error_blob) -> dict:

@@ -117,9 +117,11 @@ class Replica:
             try:
                 loop.run_until_complete(out)
             except RuntimeError as e:
-                msg = str(e)
-                if not ("different loop" in msg or "Event loop is closed" in msg
-                        or "attached to a different" in msg):
+                msg = str(e).lower()
+                # asyncio loop-affinity messages across versions: "...is
+                # bound to a different event loop", "attached to a
+                # different loop", "event loop is closed".
+                if not ("loop" in msg and ("different" in msg or "closed" in msg)):
                     raise  # a real user health failure must evict
                 # Loop-affinity only (the hook touched serving-loop-bound
                 # state): proves nothing about health — process liveness

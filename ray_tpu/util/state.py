@@ -1,0 +1,53 @@
+"""Public state API: list cluster entities.
+
+Parity target: reference python/ray/util/state/api.py (list_tasks,
+list_actors, list_objects, list_nodes, list_workers — the StateApiClient
+surface, backed here by controller queries instead of the dashboard's
+aggregator).
+"""
+
+from __future__ import annotations
+
+from ray_tpu._private.worker import global_worker
+
+
+def _call(method: str, **kw):
+    w = global_worker()
+    if w is None:
+        raise RuntimeError("ray_tpu.init() first")
+    return w.io.run(w.controller.call(method, **kw), timeout=30)
+
+
+def list_tasks(limit: int = 1000) -> list[dict]:
+    """Executed tasks (from the task-event ring) plus live queued/running
+    ones; each row has task_id/name/kind/state/node/worker/timestamps."""
+    return _call("list_tasks", limit=limit)["tasks"]
+
+
+def list_objects(limit: int = 1000) -> list[dict]:
+    return _call("list_objects", limit=limit)["objects"]
+
+
+def list_actors(limit: int = 1000) -> list[dict]:
+    snap = _call("state_snapshot")
+    out = [{"actor_id": aid, **info} for aid, info in snap["actors"].items()]
+    return out[:limit]
+
+
+def list_nodes() -> list[dict]:
+    snap = _call("state_snapshot")
+    return [{"node_id": nid, **info} for nid, info in snap["nodes"].items()]
+
+
+def list_placement_groups() -> list[dict]:
+    snap = _call("state_snapshot")
+    return [{"pg_id": pid, **info} for pid, info in snap.get("pgs", {}).items()]
+
+
+def summarize_tasks() -> dict:
+    """Counts by (name, state) — reference `ray summary tasks`."""
+    out: dict = {}
+    for t in list_tasks(limit=100_000):
+        key = (t["name"], t["state"])
+        out[key] = out.get(key, 0) + 1
+    return {f"{name}:{state}": n for (name, state), n in out.items()}

@@ -66,8 +66,9 @@ def test_kill_with_restart_no_double_instance(ray_start_2cpu):
     # Let any stale worker_died report land, then verify: exactly 1 restart
     # consumed and resources not double-released (available <= total).
     time.sleep(1.0)
-    snap = ray_tpu.timeline()
-    (actor_info,) = snap["actors"].values()
+    from ray_tpu.util import state
+
+    (actor_info,) = state.list_actors()
     assert actor_info["restarts_used"] == 1
     res = ray_tpu._require_worker().cluster_resources()
     assert res["available"].get("CPU", 0) <= res["total"].get("CPU", 0)

@@ -34,7 +34,17 @@ def test_lineage_reconstruction_node_death(ray_start_cluster):
     ref = produce.remote()
     done, _ = ray_tpu.wait([ref], num_returns=1, timeout=120)
     assert done, "produce() never finished"
-    # Do NOT get() first: the driver must not hold a local copy.
+    # Do NOT get() first: the driver must not hold a local copy. Prove the
+    # only copy actually lives on n2 (soft affinity could in principle
+    # place elsewhere, which would silently skip the reconstruction path).
+    from ray_tpu.util import state
+
+    time.sleep(0.3)  # let the holder advertise land
+    ent = next(o for o in state.list_objects(limit=10_000)
+               if o["object_id"] == ref.hex())
+    n2_addr = next(tuple(n["address"]) for n in state.list_nodes()
+                   if n["node_id"] == n2.node_id)
+    assert any(tuple(h) == n2_addr for h in ent["holders"]), (ent, n2_addr)
     cluster.remove_node(n2)
     out = ray_tpu.get(ref, timeout=120)
     assert float(out["data"].sum()) == 7.0 * (1 << 19)
