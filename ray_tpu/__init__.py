@@ -24,6 +24,7 @@ logger = logging.getLogger(__name__)
 
 _head: HeadNode | None = None
 _init_lock = threading.Lock()
+_config_baseline: dict | None = None
 
 
 def is_initialized() -> bool:
@@ -57,6 +58,8 @@ def init(
             if ignore_reinit_error:
                 return
             raise RuntimeError("ray_tpu.init() called twice; use shutdown() first.")
+        global _config_baseline
+        _config_baseline = CONFIG.snapshot()
         CONFIG.apply_system_config(_system_config)
         if address is None:
             _head = HeadNode(
@@ -92,13 +95,21 @@ def init(
 
 
 def shutdown():
-    global _head
+    global _head, _config_baseline
     w = global_worker()
     if w is not None:
         w.disconnect()
     if _head is not None:
         _head.stop()
         _head = None
+    # _system_config overrides are session-scoped: restore the pre-init
+    # snapshot so the next init() in this process starts clean.
+    if _config_baseline is not None:
+        try:
+            CONFIG.load_snapshot(_config_baseline)
+        except Exception:
+            pass
+        _config_baseline = None
     try:
         atexit.unregister(shutdown)
     except Exception:

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 import time
 from collections import deque
 from typing import Optional
@@ -108,6 +109,7 @@ class Controller:
         # that never pass through scheduling) can't leak or poison a later
         # lineage reconstruction of the same task_id.
         self.cancelled: dict[str, tuple[bool, float]] = {}
+        self._persist_dirty = False
         # task_id -> (task_done payload, expiry): completions whose task_done
         # beat the dispatch *reply* (worker reports straight to the
         # controller; the agent's reply rides another connection). Replayed
@@ -126,13 +128,95 @@ class Controller:
         self._last_need_push = 0.0
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        if CONFIG.controller_persist_dir:
+            self._restore_state()
+            self._tasks.append(asyncio.ensure_future(self._persist_loop()))
         self.port = await self.server.start(host, port)
         self._tasks.append(asyncio.ensure_future(self._schedule_loop()))
         self._tasks.append(asyncio.ensure_future(self._health_loop()))
         return self.port
 
+    # ------------------------------------------------------- persistence
+    # Reference: src/ray/gcs/store_client/redis_store_client.h — GCS state
+    # survives restarts in Redis. Here: pickled snapshots (atomic replace)
+    # of the DURABLE domains: KV, named-actor registry + actor creation
+    # specs, and PG definitions. On restore, actors re-queue as creation
+    # specs and run again once nodes join (their in-memory state restarts —
+    # reference raylets outlive the GCS so theirs keep running; our agents
+    # share fate with the controller, so re-creation is the contract).
+
+    def _persist_path(self) -> str:
+        return os.path.join(CONFIG.controller_persist_dir, "controller_state.pkl")
+
+    def _mark_dirty(self):
+        self._persist_dirty = True
+
+    def _restore_state(self):
+        import pickle
+
+        path = self._persist_path()
+        if not os.path.exists(path):
+            return
+        try:
+            with open(path, "rb") as f:
+                snap = pickle.load(f)
+        except Exception:
+            logger.exception("controller: persisted state unreadable; starting fresh")
+            return
+        self.kv = snap.get("kv", {})
+        self.named_actors = snap.get("named_actors", {})
+        for aid, spec in snap.get("actors", []):
+            ent = _ActorEntry(spec)
+            self.actors[aid] = ent
+            self.pending.append(spec)  # re-create once a node joins
+        for pid, pg in snap.get("pgs", {}).items():
+            self.pgs[pid] = {"state": "PENDING",
+                             "bundles_raw": pg["bundles_raw"],
+                             "strategy": pg["strategy"], "name": pg.get("name")}
+        logger.info(
+            "controller: restored %d kv entries, %d actors, %d pgs from %s",
+            len(self.kv), len(snap.get("actors", [])), len(self.pgs), path)
+
+    async def _persist_loop(self):
+        while True:
+            await asyncio.sleep(0.5)
+            if not self._persist_dirty:
+                continue
+            self._persist_dirty = False
+            try:
+                self._write_snapshot()
+            except Exception:
+                logger.exception("controller: persist failed")
+
+    def _write_snapshot(self):
+        import pickle
+
+        os.makedirs(CONFIG.controller_persist_dir, exist_ok=True)
+        snap = {
+            "kv": dict(self.kv),
+            "named_actors": dict(self.named_actors),
+            # Only NAMED actors: they are the reachable-after-restart
+            # contract (reference persists detached actors); resurrecting
+            # anonymous ones would leak resources nobody holds a handle to.
+            "actors": [(aid, ent.spec) for aid, ent in self.actors.items()
+                       if ent.state != "DEAD" and ent.name],
+            "pgs": {pid: {"bundles_raw": pg["bundles_raw"],
+                          "strategy": pg["strategy"], "name": pg.get("name")}
+                    for pid, pg in self.pgs.items()},
+        }
+        path = self._persist_path()
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            pickle.dump(snap, f, protocol=5)
+        os.replace(tmp, path)
+
     async def stop(self):
         self._stopping = True
+        if CONFIG.controller_persist_dir and self._persist_dirty:
+            try:
+                self._write_snapshot()  # acknowledged writes survive shutdown
+            except Exception:
+                logger.exception("controller: final persist failed")
         for nid, conn in list(self.node_conns.items()):
             try:
                 await conn.push("shutdown")
@@ -166,6 +250,9 @@ class Controller:
         elif kind == "client":
             wid = conn.meta.get("worker_id")
             self.client_conns.pop(wid, None)
+            if conn.meta.get("log_sub") and not self._any_log_sub():
+                # Last subscriber left: stop agents shipping log lines.
+                asyncio.ensure_future(self._push_log_sub_state(False))
             asyncio.ensure_future(self._reap_owner_leases(wid))
 
     # ------------------------------------------------------- registration
@@ -177,6 +264,7 @@ class Controller:
             self.nodes[nid] = node
             self.node_conns[nid] = conn
             conn.meta.update(kind="node", node_id=nid)
+            self._retry_pending_pgs()
             self._kick()
             logger.info("node %s registered with %s", nid[:8], node.total.to_dict())
         else:
@@ -800,14 +888,16 @@ class Controller:
         conn.meta["log_sub"] = bool(a.get("on", True))
         # Tell agents whether anyone is listening: unsubscribed clusters
         # must not pay per-line shipping costs.
-        on = self._any_log_sub()
+        await self._push_log_sub_state(self._any_log_sub())
+        return {}
+
+    async def _push_log_sub_state(self, on: bool):
         for nconn in self.node_conns.values():
             if not nconn.closed:
                 try:
                     await nconn.push("log_sub_state", on=on)
                 except Exception:
                     pass
-        return {}
 
     async def _h_cluster_info(self, conn, a):
         """Bootstrap info for joining nodes/CLIs (reference: ray start
@@ -894,6 +984,7 @@ class Controller:
                 raise rpc.RpcError(f"Actor name {spec.actor_name!r} already taken")
             self.named_actors[key] = spec.actor_id
         self.actors[spec.actor_id] = _ActorEntry(spec)
+        self._mark_dirty()
         self.pending.append(spec)
         self._kick()
         return {"actor_id": spec.actor_id, "existing": False}
@@ -918,6 +1009,7 @@ class Controller:
             ent.state = "DEAD"
             ent.death_cause = a["error"]
             self._release_actor_resources(ent)
+            self._mark_dirty()
             ent.wake()
             return
         ent.state = "ALIVE"
@@ -1001,6 +1093,7 @@ class Controller:
             h, b = dumps_oob({"type": "ActorDiedError", "message": reason})
             ent.death_cause = [h, *b]
             self._release_actor_resources(ent)
+            self._mark_dirty()
             ent.wake()
             if ent.name:
                 self.named_actors.pop((ent.namespace, ent.name), None)
@@ -1121,6 +1214,7 @@ class Controller:
             self.nodes[nid].available.subtract(rs)
             self.pg_bundles[(pg_id, idx)] = {"node": nid, "available": rs.copy(), "reserved": rs}
         self.pgs[pg_id] = {"state": "CREATED", "bundles_raw": a["bundles"], "strategy": strategy, "name": a.get("name")}
+        self._mark_dirty()
         self._kick()
         return {"state": "CREATED"}
 
@@ -1153,6 +1247,23 @@ class Controller:
             used_nodes.add(nid)
         return placed
 
+    def _retry_pending_pgs(self):
+        """Place PENDING placement groups (restored from a snapshot or
+        waiting for capacity) — runs when nodes join."""
+        for pg_id, pg in self.pgs.items():
+            if pg["state"] != "PENDING":
+                continue
+            bundles = [ResourceSet(_raw=raw) for raw in pg["bundles_raw"]]
+            placed = self._place_bundles(bundles, pg["strategy"])
+            if placed is None:
+                continue
+            for idx, (nid, rs) in enumerate(placed):
+                self.nodes[nid].available.subtract(rs)
+                self.pg_bundles[(pg_id, idx)] = {
+                    "node": nid, "available": rs.copy(), "reserved": rs}
+            pg["state"] = "CREATED"
+            self._mark_dirty()
+
     async def _h_pg_wait_ready(self, conn, a):
         deadline = time.monotonic() + a.get("timeout", 30.0)
         pg_id = a["pg_id"]
@@ -1170,6 +1281,7 @@ class Controller:
                     self.nodes[nid].available.subtract(rs)
                     self.pg_bundles[(pg_id, idx)] = {"node": nid, "available": rs.copy(), "reserved": rs}
                 pg["state"] = "CREATED"
+                self._mark_dirty()
                 self._kick()
                 return {"ready": True}
             await asyncio.sleep(0.05)
@@ -1178,6 +1290,7 @@ class Controller:
     async def _h_remove_pg(self, conn, a):
         pg_id = a["pg_id"]
         self.pgs.pop(pg_id, None)
+        self._mark_dirty()
         for (pgid, idx) in list(self.pg_bundles):
             if pgid == pg_id:
                 b = self.pg_bundles.pop((pgid, idx))
@@ -1192,6 +1305,7 @@ class Controller:
         key = (a.get("ns", ""), a["key"])
         if a.get("overwrite", True) or key not in self.kv:
             self.kv[key] = a["value"]
+            self._mark_dirty()
             return {"added": True}
         return {"added": False}
 
@@ -1199,7 +1313,10 @@ class Controller:
         return {"value": self.kv.get((a.get("ns", ""), a["key"]))}
 
     async def _h_kv_del(self, conn, a):
-        return {"deleted": self.kv.pop((a.get("ns", ""), a["key"]), None) is not None}
+        deleted = self.kv.pop((a.get("ns", ""), a["key"]), None) is not None
+        if deleted:
+            self._mark_dirty()
+        return {"deleted": deleted}
 
     async def _h_kv_exists(self, conn, a):
         return {"exists": (a.get("ns", ""), a["key"]) in self.kv}

@@ -34,6 +34,9 @@ _flag("max_inline_object_bytes", int, 100 * 1024)
 # Per-node shared-memory store capacity before spilling to disk.
 _flag("object_store_memory_bytes", int, 2 * 1024 * 1024 * 1024)
 _flag("object_spill_dir", str, "/tmp/ray_tpu/spill")
+# Controller state snapshots (KV, named actors, PG defs) for
+# restart-survival; empty = disabled (reference redis_store_client.h role).
+_flag("controller_persist_dir", str, "")
 _flag("shm_dir", str, "/dev/shm")
 _flag("session_dir", str, "/tmp/ray_tpu")
 _flag("min_workers_per_node", int, 0)

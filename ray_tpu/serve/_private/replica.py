@@ -118,10 +118,13 @@ class Replica:
                 loop.run_until_complete(out)
             except RuntimeError as e:
                 msg = str(e).lower()
-                # asyncio loop-affinity messages across versions: "...is
-                # bound to a different event loop", "attached to a
-                # different loop", "event loop is closed".
-                if not ("loop" in msg and ("different" in msg or "closed" in msg)):
+                # EXACT asyncio loop-affinity phrases only — a looser match
+                # would misclassify user failures like "control loop
+                # connection closed" as benign and skip eviction.
+                affinity = ("bound to a different event loop",
+                            "attached to a different loop",
+                            "event loop is closed")
+                if not any(p in msg for p in affinity):
                     raise  # a real user health failure must evict
                 # Loop-affinity only (the hook touched serving-loop-bound
                 # state): proves nothing about health — process liveness

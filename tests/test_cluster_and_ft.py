@@ -136,3 +136,36 @@ def test_infeasible_pg_pending(ray_start_cluster):
 
     pg = placement_group([{"CPU": 99}], strategy="PACK")
     assert not pg.wait(timeout_seconds=0.5)
+
+
+def test_controller_persistence_restart(shutdown_only, tmp_path):
+    """KV contents and named actors survive a full controller restart: the
+    new controller restores its snapshot and re-creates the actor from its
+    persisted spec once the node joins (reference GCS+Redis restart,
+    redis_store_client.h — our agents share fate with the controller, so
+    re-creation rather than adoption is the contract)."""
+    persist = str(tmp_path / "ctrl")
+
+    ray_tpu.init(num_cpus=2, _system_config={"controller_persist_dir": persist})
+
+    @ray_tpu.remote
+    class Registry:
+        def __init__(self):
+            self.greeting = "hello-from-v1"
+
+        def greet(self):
+            return self.greeting
+
+    reg = Registry.options(name="registry", lifetime="detached").remote()
+    assert ray_tpu.get(reg.greet.remote(), timeout=60) == "hello-from-v1"
+    from ray_tpu._private.worker import global_worker
+
+    global_worker().kv("put", ns="app", key="cfg", value=b"v42")
+    ray_tpu.shutdown()  # stop() flushes dirty state before exiting
+
+    # Fresh cluster, same persist dir: restore.
+    ray_tpu.init(num_cpus=2, _system_config={"controller_persist_dir": persist})
+    w = global_worker()
+    assert w.kv("get", ns="app", key="cfg")["value"] == b"v42"
+    reg2 = ray_tpu.get_actor("registry")
+    assert ray_tpu.get(reg2.greet.remote(), timeout=120) == "hello-from-v1"

@@ -75,7 +75,7 @@ def test_chaos_mixed_workload(ray_start_cluster):
     cluster.add_node(num_cpus=2)
     ray_tpu.init(address=cluster.address)
 
-    @ray_tpu.remote(max_retries=8)
+    @ray_tpu.remote(max_retries=16)  # chaos can kill the same task repeatedly
     def flaky_sum(i):
         time.sleep(0.25)
         return i * 2
