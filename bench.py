@@ -208,6 +208,25 @@ def main():
     except Exception as e:
         log(f"  flash attention skipped: {e}")
 
+    # ---- RLlib PPO env-steps/sec (BASELINE north-star workload) ----------
+    try:
+        from ray_tpu.rllib import PPOConfig
+
+        algo = (PPOConfig()
+                .environment("CartPole-v1")
+                .env_runners(num_env_runners=2, num_envs_per_env_runner=8,
+                             rollout_fragment_length=64)
+                .build())
+        algo.train()  # warm: jit compiles, runners spin up
+        t0 = time.perf_counter()
+        steps = sum(algo.train()["num_env_steps_sampled"] for _ in range(5))
+        rate = steps / (time.perf_counter() - t0)
+        results["ppo_env_steps_per_s"] = rate
+        log(f"  rllib ppo: {rate:,.0f} env-steps/s (CartPole, 2 runners)")
+        algo.stop()
+    except Exception as e:
+        log(f"  rllib ppo skipped: {e}")
+
     ray_tpu.shutdown()
 
     ratios = {k: results[k] / BASELINES[k] for k in BASELINES if k in results}

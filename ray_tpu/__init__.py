@@ -59,7 +59,10 @@ def init(
                 return
             raise RuntimeError("ray_tpu.init() called twice; use shutdown() first.")
         global _config_baseline
-        _config_baseline = CONFIG.snapshot()
+        # Save the OVERRIDE table, not the resolved values: restoring the
+        # full resolved snapshot would freeze every flag as an override and
+        # silently disable RT_* env resolution for the rest of the process.
+        _config_baseline = dict(CONFIG._overrides)
         CONFIG.apply_system_config(_system_config)
         if address is None:
             _head = HeadNode(
@@ -103,10 +106,11 @@ def shutdown():
         _head.stop()
         _head = None
     # _system_config overrides are session-scoped: restore the pre-init
-    # snapshot so the next init() in this process starts clean.
+    # override table so the next init() in this process starts clean.
     if _config_baseline is not None:
         try:
-            CONFIG.load_snapshot(_config_baseline)
+            CONFIG._overrides.clear()
+            CONFIG._overrides.update(_config_baseline)
         except Exception:
             pass
         _config_baseline = None

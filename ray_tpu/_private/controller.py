@@ -183,16 +183,17 @@ class Controller:
             if not self._persist_dirty:
                 continue
             self._persist_dirty = False
+            snap = self._build_snapshot()  # consistent view, on the loop
             try:
-                self._write_snapshot()
+                # The pickle+write happens OFF the event loop: a large KV
+                # must not stall heartbeats/scheduling for the write.
+                await asyncio.to_thread(self._dump_snapshot, snap)
             except Exception:
+                self._persist_dirty = True  # acknowledged state must retry
                 logger.exception("controller: persist failed")
 
-    def _write_snapshot(self):
-        import pickle
-
-        os.makedirs(CONFIG.controller_persist_dir, exist_ok=True)
-        snap = {
+    def _build_snapshot(self) -> dict:
+        return {
             "kv": dict(self.kv),
             "named_actors": dict(self.named_actors),
             # Only NAMED actors: they are the reachable-after-restart
@@ -204,11 +205,19 @@ class Controller:
                           "strategy": pg["strategy"], "name": pg.get("name")}
                     for pid, pg in self.pgs.items()},
         }
+
+    def _dump_snapshot(self, snap: dict):
+        import pickle
+
+        os.makedirs(CONFIG.controller_persist_dir, exist_ok=True)
         path = self._persist_path()
         tmp = path + ".tmp"
         with open(tmp, "wb") as f:
             pickle.dump(snap, f, protocol=5)
         os.replace(tmp, path)
+
+    def _write_snapshot(self):
+        self._dump_snapshot(self._build_snapshot())
 
     async def stop(self):
         self._stopping = True
@@ -1247,22 +1256,27 @@ class Controller:
             used_nodes.add(nid)
         return placed
 
+    def _try_place_pg(self, pg_id: str, pg: dict) -> bool:
+        """Place + commit a PG's bundles; True on success (state CREATED,
+        dirty marked). The ONE implementation all creation/retry paths use."""
+        bundles = [ResourceSet(_raw=raw) for raw in pg["bundles_raw"]]
+        placed = self._place_bundles(bundles, pg["strategy"])
+        if placed is None:
+            return False
+        for idx, (nid, rs) in enumerate(placed):
+            self.nodes[nid].available.subtract(rs)
+            self.pg_bundles[(pg_id, idx)] = {
+                "node": nid, "available": rs.copy(), "reserved": rs}
+        pg["state"] = "CREATED"
+        self._mark_dirty()
+        return True
+
     def _retry_pending_pgs(self):
         """Place PENDING placement groups (restored from a snapshot or
         waiting for capacity) — runs when nodes join."""
         for pg_id, pg in self.pgs.items():
-            if pg["state"] != "PENDING":
-                continue
-            bundles = [ResourceSet(_raw=raw) for raw in pg["bundles_raw"]]
-            placed = self._place_bundles(bundles, pg["strategy"])
-            if placed is None:
-                continue
-            for idx, (nid, rs) in enumerate(placed):
-                self.nodes[nid].available.subtract(rs)
-                self.pg_bundles[(pg_id, idx)] = {
-                    "node": nid, "available": rs.copy(), "reserved": rs}
-            pg["state"] = "CREATED"
-            self._mark_dirty()
+            if pg["state"] == "PENDING":
+                self._try_place_pg(pg_id, pg)
 
     async def _h_pg_wait_ready(self, conn, a):
         deadline = time.monotonic() + a.get("timeout", 30.0)
@@ -1274,14 +1288,7 @@ class Controller:
             if pg["state"] == "CREATED":
                 return {"ready": True}
             # Retry placement (nodes may have joined/freed).
-            bundles = [ResourceSet(_raw=raw) for raw in pg["bundles_raw"]]
-            placed = self._place_bundles(bundles, pg["strategy"])
-            if placed is not None:
-                for idx, (nid, rs) in enumerate(placed):
-                    self.nodes[nid].available.subtract(rs)
-                    self.pg_bundles[(pg_id, idx)] = {"node": nid, "available": rs.copy(), "reserved": rs}
-                pg["state"] = "CREATED"
-                self._mark_dirty()
+            if self._try_place_pg(pg_id, pg):
                 self._kick()
                 return {"ready": True}
             await asyncio.sleep(0.05)

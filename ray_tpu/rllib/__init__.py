@@ -1,0 +1,37 @@
+"""ray_tpu.rllib — reinforcement learning on the cluster runtime.
+
+Parity target: reference rllib/ new API stack (Algorithm / AlgorithmConfig,
+RLModule, Learner, EnvRunner/EnvRunnerGroup). JAX-native: the policy is a
+flax module, the PPO update is one compiled program (all epochs/minibatches
+inside lax.scan), rollouts run on parallel env-runner actors with numpy
+vector envs.
+"""
+
+from ray_tpu.rllib.algorithm import (
+    Algorithm,
+    AlgorithmConfig,
+    EnvRunnerGroup,
+    PPO,
+    PPOConfig,
+)
+from ray_tpu.rllib.env import ENV_REGISTRY, CartPoleVecEnv, make_vec_env
+from ray_tpu.rllib.env_runner import SingleAgentEnvRunner
+from ray_tpu.rllib.learner import PPOLearner, PPOLearnerConfig, compute_gae
+from ray_tpu.rllib.rl_module import RLModule, RLModuleSpec
+
+__all__ = [
+    "Algorithm",
+    "AlgorithmConfig",
+    "CartPoleVecEnv",
+    "ENV_REGISTRY",
+    "EnvRunnerGroup",
+    "PPO",
+    "PPOConfig",
+    "PPOLearner",
+    "PPOLearnerConfig",
+    "RLModule",
+    "RLModuleSpec",
+    "SingleAgentEnvRunner",
+    "compute_gae",
+    "make_vec_env",
+]

@@ -1,0 +1,76 @@
+"""EnvRunner: the rollout actor.
+
+Parity target: reference rllib/env/single_agent_env_runner.py:68 +
+env_runner_group.py:71 — a fleet of actors each stepping a vectorized env
+with the current policy, returning sample batches; weights broadcast each
+iteration.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.rllib.env import make_vec_env
+from ray_tpu.rllib.rl_module import RLModule, RLModuleSpec
+
+
+class SingleAgentEnvRunner:
+    """Wrapped with ray_tpu.remote by EnvRunnerGroup (so per-runner
+    resources can be attached)."""
+
+    def __init__(self, env_name, num_envs: int, module_spec: RLModuleSpec,
+                 seed: int = 0):
+        self.env = make_vec_env(env_name, num_envs, seed=seed)
+        self.module = RLModule(module_spec)
+        self.params = None
+        self._rng = jax.random.PRNGKey(seed)
+        self._explore = jax.jit(self.module.forward_exploration)
+        self.obs = self.env.obs()
+        # episode-return bookkeeping (reference metrics: episode_return_mean)
+        self._ep_ret = np.zeros(num_envs, dtype=np.float64)
+        self._done_returns: list[float] = []
+
+    def set_weights(self, weights):
+        self.params = weights
+        return True
+
+    def sample(self, num_steps: int) -> dict:
+        """Roll out num_steps per env with the CURRENT weights. Returns a
+        [T, N, ...] batch (numpy) + rollout metrics."""
+        assert self.params is not None, "set_weights first"
+        T, N = num_steps, self.env.num_envs
+        obs_buf = np.zeros((T, N, self.env.observation_dim), np.float32)
+        act_buf = np.zeros((T, N), np.int32)
+        logp_buf = np.zeros((T, N), np.float32)
+        val_buf = np.zeros((T, N), np.float32)
+        rew_buf = np.zeros((T, N), np.float32)
+        done_buf = np.zeros((T, N), np.float32)
+        for t in range(T):
+            self._rng, sub = jax.random.split(self._rng)
+            action, logp, value = self._explore(
+                self.params, jnp.asarray(self.obs), sub)
+            action = np.asarray(action)
+            obs_buf[t] = self.obs
+            act_buf[t] = action
+            logp_buf[t] = np.asarray(logp)
+            val_buf[t] = np.asarray(value)
+            self.obs, rewards, dones = self.env.step(action)
+            rew_buf[t] = rewards
+            done_buf[t] = dones
+            self._ep_ret += rewards
+            finished = dones.astype(bool)
+            if finished.any():
+                self._done_returns.extend(self._ep_ret[finished].tolist())
+                self._ep_ret[finished] = 0.0
+        _, last_values = self.module.forward_train(
+            self.params, jnp.asarray(self.obs))
+        returns, self._done_returns = self._done_returns, []
+        return {
+            "obs": obs_buf, "actions": act_buf, "logp_old": logp_buf,
+            "values": val_buf, "rewards": rew_buf, "dones": done_buf,
+            "last_values": np.asarray(last_values),
+            "episode_returns": returns,
+        }

@@ -1,0 +1,66 @@
+"""RLModule: the policy/value network abstraction.
+
+Parity target: reference rllib/core/rl_module/rl_module.py:260 (the new-API
+RLModule with forward_inference / forward_exploration / forward_train) —
+implemented as a flax module whose forward passes are pure functions, so
+the learner jits the whole PPO update and the env-runner jits action
+sampling; on TPU the same module drops into a pjit mesh unchanged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+@dataclass(frozen=True)
+class RLModuleSpec:
+    """reference rl_module.RLModuleSpec: how to build the module."""
+
+    observation_dim: int
+    action_dim: int
+    hidden: tuple = (64, 64)
+
+
+class PolicyValueNet(nn.Module):
+    spec: RLModuleSpec
+
+    @nn.compact
+    def __call__(self, obs):
+        x = obs
+        for i, h in enumerate(self.spec.hidden):
+            x = nn.tanh(nn.Dense(h, name=f"fc{i}")(x))
+        logits = nn.Dense(self.spec.action_dim, name="pi")(x)
+        value = nn.Dense(1, name="vf")(x)[..., 0]
+        return logits, value
+
+
+class RLModule:
+    """Bundles the flax net with the reference's forward_* surface."""
+
+    def __init__(self, spec: RLModuleSpec):
+        self.spec = spec
+        self.net = PolicyValueNet(spec)
+
+    def init(self, rng):
+        dummy = jnp.zeros((1, self.spec.observation_dim), jnp.float32)
+        return self.net.init(rng, dummy)
+
+    def forward_train(self, params, obs):
+        """-> (logits, values); used inside the PPO loss."""
+        return self.net.apply(params, obs)
+
+    def forward_exploration(self, params, obs, rng):
+        """Sample actions + logp + value (env-runner rollout step)."""
+        logits, value = self.net.apply(params, obs)
+        action = jax.random.categorical(rng, logits, axis=-1)
+        logp = jax.nn.log_softmax(logits)[jnp.arange(action.shape[0]), action]
+        return action, logp, value
+
+    def forward_inference(self, params, obs):
+        """Greedy actions (serving/eval)."""
+        logits, _ = self.net.apply(params, obs)
+        return jnp.argmax(logits, axis=-1)

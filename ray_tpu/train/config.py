@@ -77,6 +77,9 @@ class RunConfig:
     storage_path: Optional[str] = None
     failure_config: FailureConfig = field(default_factory=FailureConfig)
     checkpoint_config: CheckpointConfig = field(default_factory=CheckpointConfig)
+    #: Tune stop criteria: {"metric": threshold} — a trial stops once any
+    #: reported metric reaches its threshold (reference air.RunConfig stop).
+    stop: Optional[dict] = None
 
     def resolved_storage(self) -> str:
         return self.storage_path or os.path.join(
