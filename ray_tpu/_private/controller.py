@@ -110,6 +110,9 @@ class Controller:
         # lineage reconstruction of the same task_id.
         self.cancelled: dict[str, tuple[bool, float]] = {}
         self._persist_dirty = False
+        import threading as _threading
+
+        self._persist_io_lock = _threading.Lock()
         # task_id -> (task_done payload, expiry): completions whose task_done
         # beat the dispatch *reply* (worker reports straight to the
         # controller; the agent's reply rides another connection). Replayed
@@ -126,6 +129,10 @@ class Controller:
         # resources and brokers worker acquisition. lease_id -> entry.
         self.leases: dict[str, dict] = {}
         self._last_need_push = 0.0
+        # worker_ids that ever hosted an actor instance: the fate-sharing
+        # reaper must recognize an actor owner even after its entry's
+        # worker_id was cleared by the death bookkeeping.
+        self._actor_host_workers: set[str] = set()
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
         if CONFIG.controller_persist_dir:
@@ -196,11 +203,11 @@ class Controller:
         return {
             "kv": dict(self.kv),
             "named_actors": dict(self.named_actors),
-            # Only NAMED actors: they are the reachable-after-restart
-            # contract (reference persists detached actors); resurrecting
-            # anonymous ones would leak resources nobody holds a handle to.
+            # Only DETACHED actors (reference persists detached actors):
+            # everything else fate-shares with its owner, which did not
+            # survive the restart either.
             "actors": [(aid, ent.spec) for aid, ent in self.actors.items()
-                       if ent.state != "DEAD" and ent.name],
+                       if ent.state != "DEAD" and ent.spec.lifetime == "detached"],
             "pgs": {pid: {"bundles_raw": pg["bundles_raw"],
                           "strategy": pg["strategy"], "name": pg.get("name")}
                     for pid, pg in self.pgs.items()},
@@ -209,12 +216,16 @@ class Controller:
     def _dump_snapshot(self, snap: dict):
         import pickle
 
-        os.makedirs(CONFIG.controller_persist_dir, exist_ok=True)
-        path = self._persist_path()
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            pickle.dump(snap, f, protocol=5)
-        os.replace(tmp, path)
+        # Serializes the threaded persist-loop dump against stop()'s final
+        # synchronous flush: both target the same tmp file, and the LAST
+        # writer must be the newest snapshot.
+        with self._persist_io_lock:
+            os.makedirs(CONFIG.controller_persist_dir, exist_ok=True)
+            path = self._persist_path()
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                pickle.dump(snap, f, protocol=5)
+            os.replace(tmp, path)
 
     def _write_snapshot(self):
         self._dump_snapshot(self._build_snapshot())
@@ -263,6 +274,8 @@ class Controller:
                 # Last subscriber left: stop agents shipping log lines.
                 asyncio.ensure_future(self._push_log_sub_state(False))
             asyncio.ensure_future(self._reap_owner_leases(wid))
+            asyncio.ensure_future(
+                self._reap_owned_actors(wid, conn.meta.get("mode")))
 
     # ------------------------------------------------------- registration
     async def _h_register(self, conn, a):
@@ -279,7 +292,9 @@ class Controller:
         else:
             wid = a["worker_id"]
             self.client_conns[wid] = conn
-            conn.meta.update(kind="client", worker_id=wid, address=tuple(a["address"]) if a.get("address") else None)
+            conn.meta.update(kind="client", worker_id=wid,
+                             mode=a.get("mode"),
+                             address=tuple(a["address"]) if a.get("address") else None)
         return {"session_id": self.session_id, "config": CONFIG.snapshot(),
                 "log_sub": self._any_log_sub()}
 
@@ -1023,6 +1038,8 @@ class Controller:
             return
         ent.state = "ALIVE"
         ent.address = tuple(a["actor_address"])
+        if ent.worker_id:
+            self._actor_host_workers.add(ent.worker_id)
         ent.instance += 1
         ent.wake()
         logger.info("actor %s alive at %s", spec.name, ent.address)
@@ -1065,6 +1082,31 @@ class Controller:
             "death_cause": ent.death_cause,
             "max_task_retries": ent.spec.max_task_retries,
         }
+
+    async def _reap_owned_actors(self, owner: str, owner_mode):
+        """Ownership fate-sharing (reference gcs_actor_manager
+        OnWorkerDead/OnJobFinished): when a DRIVER or an actor-hosting
+        worker disconnects, its non-detached actors die with it. Pooled
+        task workers are exempt — they exit routinely (idle reaping) and a
+        task-created actor must outlive the transient worker that ran the
+        creating task."""
+        if owner_mode != "driver" and owner not in self._actor_host_workers:
+            return
+        for aid, ent in list(self.actors.items()):
+            if (ent.spec.owner_id == owner and ent.state != "DEAD"
+                    and ent.spec.lifetime != "detached"):
+                logger.info("actor %s dies with its owner %s (fate-sharing)",
+                            aid[:8], owner[:8])
+                ent.spec.max_restarts = 0
+                wid = ent.worker_id
+                if wid is not None and ent.node_id in self.node_conns:
+                    try:
+                        await self.node_conns[ent.node_id].push(
+                            "kill_worker", worker_id=wid)
+                    except Exception:
+                        pass
+                await self._actor_worker_died(
+                    aid, "owner disconnected (fate-sharing)", worker_id=wid)
 
     async def _h_kill_actor(self, conn, a):
         ent = self.actors.get(a["actor_id"])

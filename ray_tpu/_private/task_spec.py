@@ -70,6 +70,9 @@ class TaskSpec:
     actor_name: Optional[str] = None
     namespace: str = "default"
     get_if_exists: bool = False
+    #: "detached" = survives its owner (reference actor lifetime); None =
+    #: dies with the owner (fate-sharing) and is never persisted.
+    lifetime: Optional[str] = None
     # retry bookkeeping (mutated by controller):
     attempt: int = 0
 
@@ -80,7 +83,8 @@ class TaskSpec:
                 self.retry_exceptions, self.runtime_env, self.owner_id,
                 self.owner_addr, self.actor_id, self.max_restarts,
                 self.max_task_retries, self.max_concurrency, self.actor_name,
-                self.namespace, self.get_if_exists, self.attempt)
+                self.namespace, self.get_if_exists, self.lifetime,
+                self.attempt)
 
     def __setstate__(self, s):
         (self.task_id, self.kind, self.name, self.function_id,
@@ -89,7 +93,8 @@ class TaskSpec:
          self.retry_exceptions, self.runtime_env, self.owner_id,
          self.owner_addr, self.actor_id, self.max_restarts,
          self.max_task_retries, self.max_concurrency, self.actor_name,
-         self.namespace, self.get_if_exists, self.attempt) = s
+         self.namespace, self.get_if_exists, self.lifetime,
+         self.attempt) = s
 
     def clone(self) -> "TaskSpec":
         """Shallow copy with its own SchedulingStrategy. The controller

@@ -236,7 +236,8 @@ class Worker:
                 on_close=self._on_ctrl_close,
             )
             rep = await self.controller.call(
-                "register", kind="client", worker_id=self.worker_id, address=self.server_addr
+                "register", kind="client", worker_id=self.worker_id,
+                mode=self.mode, address=self.server_addr
             )
             CONFIG.load_snapshot(rep["config"])
 
@@ -857,7 +858,7 @@ class Worker:
                      get_if_exists=False, resources: ResourceSet,
                      strategy: SchedulingStrategy | None = None, max_restarts=0,
                      max_task_retries=0, max_concurrency=1, runtime_env=None,
-                     actor_display_name=None) -> str:
+                     actor_display_name=None, lifetime=None) -> str:
         from ray_tpu._private.ids import ActorID
 
         fid = self._register_function(cls)
@@ -889,6 +890,7 @@ class Worker:
             actor_name=name,
             namespace=namespace,
             get_if_exists=get_if_exists,
+            lifetime=lifetime,
         )
         rep = self.io.run(self.controller.call("create_actor", spec=spec))
         return rep["actor_id"]

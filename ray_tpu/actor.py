@@ -98,8 +98,8 @@ class ActorClass:
         lifetime = o.get("lifetime")
         if lifetime not in (None, "detached", "non_detached"):
             raise ValueError(f"lifetime must be None, 'detached' or 'non_detached', got {lifetime!r}")
-        # Note: all actors currently survive their creator (controller-owned
-        # state), so 'detached' is the de-facto behavior; accepted for parity.
+        # Non-detached actors fate-share with a driver/actor owner
+        # (controller _reap_owned_actors); 'detached' opts out.
         num_tpus = o.get("num_tpus", o.get("num_gpus"))
         resources = normalize_resources(
             num_cpus=o.get("num_cpus"),
@@ -130,6 +130,7 @@ class ActorClass:
             max_concurrency=o.get("max_concurrency", 1),
             runtime_env=o.get("runtime_env"),
             actor_display_name=self._cls.__name__,
+            lifetime=None if lifetime == "non_detached" else lifetime,
         )
         return ActorHandle(actor_id, max_task_retries=o.get("max_task_retries", 0))
 

@@ -10,6 +10,7 @@ from ray_tpu.train.config import (
     ScalingConfig,
 )
 from ray_tpu.train._internal.controller import Result
+from ray_tpu.air import session
 
 __all__ = ["Checkpoint", "CheckpointConfig", "FailureConfig", "RunConfig",
-           "ScalingConfig", "Result"]
+           "ScalingConfig", "Result", "session"]

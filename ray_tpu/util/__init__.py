@@ -1,0 +1,18 @@
+"""ray_tpu.util — utility layer over the core runtime.
+
+Parity target: reference python/ray/util/ — ActorPool, Queue,
+multiprocessing.Pool, collective groups, placement groups, scheduling
+strategies, the state API, and chaos tooling.
+"""
+
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.placement_group import placement_group
+from ray_tpu.util.queue import Empty, Full, Queue
+
+__all__ = [
+    "ActorPool",
+    "Empty",
+    "Full",
+    "Queue",
+    "placement_group",
+]

@@ -1,0 +1,105 @@
+"""multiprocessing.Pool API over cluster tasks.
+
+Parity target: reference python/ray/util/multiprocessing/pool.py — drop-in
+Pool so `from multiprocessing import Pool` code scales past one machine by
+switching the import.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Iterable, Optional
+
+import ray_tpu
+
+
+class AsyncResult:
+    def __init__(self, refs: list, single: bool):
+        self._refs = refs
+        self._single = single
+
+    def get(self, timeout: Optional[float] = None):
+        out = ray_tpu.get(self._refs, timeout=timeout)
+        return out[0] if self._single else out
+
+    def wait(self, timeout: Optional[float] = None):
+        ray_tpu.wait(self._refs, num_returns=len(self._refs), timeout=timeout)
+
+    def ready(self) -> bool:
+        done, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                               timeout=0)
+        return len(done) == len(self._refs)
+
+    def successful(self) -> bool:
+        try:
+            self.get(timeout=0.001)
+            return True
+        except Exception:
+            return False
+
+
+class Pool:
+    """Task-backed process pool. `processes` caps in-flight parallelism
+    (cluster CPUs do the real limiting)."""
+
+    def __init__(self, processes: Optional[int] = None):
+        self._processes = processes
+        self._closed = False
+
+        @ray_tpu.remote
+        def _run(fn, args, kwargs):
+            return fn(*args, **(kwargs or {}))
+
+        self._run = _run
+
+    def apply(self, fn: Callable, args: tuple = (), kwds: dict | None = None):
+        return self.apply_async(fn, args, kwds).get()
+
+    def apply_async(self, fn: Callable, args: tuple = (),
+                    kwds: dict | None = None) -> AsyncResult:
+        assert not self._closed, "Pool is closed"
+        return AsyncResult([self._run.remote(fn, tuple(args), kwds)], True)
+
+    def map(self, fn: Callable, iterable: Iterable,
+            chunksize: Optional[int] = None) -> list:
+        return self.map_async(fn, iterable, chunksize).get()
+
+    def map_async(self, fn: Callable, iterable: Iterable,
+                  chunksize: Optional[int] = None) -> AsyncResult:
+        assert not self._closed, "Pool is closed"
+        refs = [self._run.remote(fn, (v,), None) for v in iterable]
+        return AsyncResult(refs, False)
+
+    def starmap(self, fn: Callable, iterable: Iterable[tuple]) -> list:
+        assert not self._closed, "Pool is closed"
+        refs = [self._run.remote(fn, tuple(v), None) for v in iterable]
+        return AsyncResult(refs, False).get()
+
+    def imap(self, fn: Callable, iterable: Iterable,
+             chunksize: Optional[int] = None):
+        refs = [self._run.remote(fn, (v,), None) for v in iterable]
+        for r in refs:
+            yield ray_tpu.get(r, timeout=None)
+
+    def imap_unordered(self, fn: Callable, iterable: Iterable,
+                       chunksize: Optional[int] = None):
+        pending = [self._run.remote(fn, (v,), None) for v in iterable]
+        while pending:
+            done, pending = ray_tpu.wait(pending, num_returns=1, timeout=None)
+            for d in done:
+                yield ray_tpu.get(d, timeout=60)
+
+    def close(self):
+        self._closed = True
+
+    def terminate(self):
+        self._closed = True
+
+    def join(self):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.terminate()

@@ -177,3 +177,40 @@ def test_actor_task_transparent_retry(ray_start_2cpu, tmp_path):
 
     a = DieOnce.remote()
     assert ray_tpu.get(a.work.remote(marker), timeout=60) == 42
+
+
+def test_actor_fate_sharing_with_owner(ray_start_4cpu):
+    """Non-detached actors created BY an actor die when their owner dies
+    (reference gcs_actor_manager OnWorkerDead); detached ones survive."""
+
+    @ray_tpu.remote
+    class Child:
+        def ping(self):
+            return "pong"
+
+    @ray_tpu.remote
+    class Owner:
+        def __init__(self):
+            self.child = Child.remote()
+            self.free_child = Child.options(
+                name="freechild", lifetime="detached").remote()
+
+        def handles(self):
+            return self.child, self.free_child
+
+    owner = Owner.remote()
+    child, free_child = ray_tpu.get(owner.handles.remote(), timeout=60)
+    assert ray_tpu.get(child.ping.remote(), timeout=60) == "pong"
+    ray_tpu.kill(owner)
+    # non-detached child dies with its owner
+    deadline = time.time() + 30
+    died = False
+    while time.time() < deadline and not died:
+        try:
+            ray_tpu.get(child.ping.remote(), timeout=5)
+            time.sleep(0.2)
+        except Exception:
+            died = True
+    assert died, "non-detached child survived its owner"
+    # detached child keeps serving
+    assert ray_tpu.get(free_child.ping.remote(), timeout=60) == "pong"

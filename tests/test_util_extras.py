@@ -1,0 +1,90 @@
+"""util extras: ActorPool, Queue, multiprocessing.Pool, air.session.
+
+reference tests: python/ray/tests/test_actor_pool.py, test_queue.py,
+test_multiprocessing.py.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import ActorPool, Empty, Queue
+
+
+def test_actor_pool_map_ordered_and_unordered(ray_start_2cpu):
+    @ray_tpu.remote
+    class Sq:
+        def sq(self, x):
+            return x * x
+
+    actors = [Sq.remote() for _ in range(2)]
+    pool = ActorPool(actors)
+    assert list(pool.map(lambda a, v: a.sq.remote(v), range(8))) == [
+        i * i for i in range(8)]
+    out = sorted(pool.map_unordered(lambda a, v: a.sq.remote(v), range(8)))
+    assert out == sorted(i * i for i in range(8))
+    # submit/get_next interleave; more submits than actors queues work
+    for i in range(5):
+        pool.submit(lambda a, v: a.sq.remote(v), i)
+    got = [pool.get_next(timeout=60) for _ in range(5)]
+    assert got == [0, 1, 4, 9, 16]
+
+
+def test_queue_basic_and_cross_actor(ray_start_2cpu):
+    q = Queue(maxsize=4)
+    q.put("a")
+    q.put("b")
+    assert q.qsize() == 2
+    assert q.get() == "a"
+    with pytest.raises(Empty):
+        q.get_nowait() and q.get_nowait()  # only one item left
+        q.get_nowait()
+
+    # a worker task produces through the SAME queue (handle pickles)
+    @ray_tpu.remote
+    def producer(q, n):
+        for i in range(n):
+            q.put(i)
+        return True
+
+    ref = producer.remote(q, 3)
+    got = [q.get(timeout=30) for _ in range(3)]
+    assert got == [0, 1, 2]
+    assert ray_tpu.get(ref, timeout=60) is True
+    # blocking get times out cleanly
+    with pytest.raises(Empty):
+        q.get(timeout=0.2)
+    q.shutdown()
+
+
+def test_multiprocessing_pool(ray_start_2cpu):
+    from ray_tpu.util.multiprocessing import Pool
+
+    def cube(x):
+        return x ** 3
+
+    def add(a, b):
+        return a + b
+
+    with Pool() as p:
+        assert p.map(cube, range(6)) == [i ** 3 for i in range(6)]
+        assert p.starmap(add, [(1, 2), (3, 4)]) == [3, 7]
+        ar = p.apply_async(cube, (5,))
+        assert ar.get(timeout=60) == 125
+        assert sorted(p.imap_unordered(cube, range(4))) == [0, 1, 8, 27]
+
+
+def test_air_session_in_trainer(ray_start_2cpu, tmp_path):
+    from ray_tpu.air import session
+    from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig
+
+    def loop(config):
+        session.report({"rank": session.get_world_rank(),
+                        "world": session.get_world_size()})
+
+    res = JaxTrainer(
+        loop, scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(storage_path=str(tmp_path))).fit()
+    assert res.error is None
+    assert res.metrics["world"] == 2
