@@ -202,7 +202,12 @@ class Controller:
     def _build_snapshot(self) -> dict:
         return {
             "kv": dict(self.kv),
-            "named_actors": dict(self.named_actors),
+            # names only for actors that are themselves persisted — a
+            # dangling name->id mapping would break name reuse after restore
+            "named_actors": {
+                k: aid for k, aid in self.named_actors.items()
+                if (e := self.actors.get(aid)) is not None
+                and e.state != "DEAD" and e.spec.lifetime == "detached"},
             # Only DETACHED actors (reference persists detached actors):
             # everything else fate-shares with its owner, which did not
             # survive the restart either.
@@ -1098,6 +1103,14 @@ class Controller:
                 logger.info("actor %s dies with its owner %s (fate-sharing)",
                             aid[:8], owner[:8])
                 ent.spec.max_restarts = 0
+                if ent.state in ("RESTARTING", "PENDING"):
+                    # No live instance to kill and _actor_worker_died would
+                    # no-op: cancel the queued respawn and bury it directly.
+                    for spec in list(self.pending):
+                        if spec.actor_id == aid:
+                            self.pending.remove(spec)
+                    self._bury_actor(ent, "owner disconnected (fate-sharing)")
+                    continue
                 wid = ent.worker_id
                 if wid is not None and ent.node_id in self.node_conns:
                     try:
@@ -1107,6 +1120,18 @@ class Controller:
                         pass
                 await self._actor_worker_died(
                     aid, "owner disconnected (fate-sharing)", worker_id=wid)
+
+    def _bury_actor(self, ent, reason: str):
+        from ray_tpu._private.serialization import dumps_oob
+
+        ent.state = "DEAD"
+        h, b = dumps_oob({"type": "ActorDiedError", "message": reason})
+        ent.death_cause = [h, *b]
+        self._release_actor_resources(ent)
+        self._mark_dirty()
+        ent.wake()
+        if ent.name:
+            self.named_actors.pop((ent.namespace, ent.name), None)
 
     async def _h_kill_actor(self, conn, a):
         ent = self.actors.get(a["actor_id"])

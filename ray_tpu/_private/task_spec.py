@@ -87,6 +87,8 @@ class TaskSpec:
                 self.attempt)
 
     def __setstate__(self, s):
+        if len(s) == 22:  # pre-'lifetime' snapshots: default None
+            s = s[:21] + (None,) + s[21:]
         (self.task_id, self.kind, self.name, self.function_id,
          self.method_name, self.args, self.kwargs, self.num_returns,
          self.resources, self.strategy, self.max_retries,

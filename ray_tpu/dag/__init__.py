@@ -1,0 +1,150 @@
+"""Compiled graphs: pre-wired actor pipelines over shm channels.
+
+Parity target: reference python/ray/dag/compiled_dag_node.py:805
+(experimental_compile — turn a bound DAG into persistent per-actor
+execution loops connected by mutable shm channels, removing ALL per-call
+RPC/scheduling from the steady state) + experimental/channel/.
+
+Surface: function DAGs built with `.bind()`:
+
+    with InputNode() as inp:
+        dag = postprocess.bind(model_forward.bind(inp))
+    cdag = compile(dag)           # stage actors + channels come up once
+    out = cdag.execute(x)         # shm write -> pipeline -> shm read
+    cdag.teardown()
+
+Each DAG node becomes a dedicated stage ACTOR running a channel loop: the
+driver writes the input channel and reads the output channel; intermediate
+hops never touch the control plane. (The reference compiles existing-actor
+method DAGs; stage actors are this round's functional equivalent for the
+function-DAG surface.)
+"""
+
+from __future__ import annotations
+
+import uuid
+from typing import Any, Optional
+
+import ray_tpu
+from ray_tpu.experimental.channel import Channel
+from ray_tpu.workflow import DAGNode
+
+
+class InputNode:
+    """Placeholder for the execute() argument (reference dag.InputNode)."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+class _StageActor:
+    """Hosts one compiled stage: a loop pulling from the in-channel,
+    applying the stage function, pushing to the out-channel."""
+
+    def __init__(self, fn, in_name: str, out_name: str, size: int):
+        self.fn = fn
+        self.in_ch = Channel(in_name, size, _create=False)
+        self.out_ch = Channel(out_name, size, _create=False)
+        self._stop = False
+
+    def run_loop(self):
+        while True:
+            try:
+                item = self.in_ch.read(timeout=0.5)
+            except TimeoutError:
+                if self._stop:
+                    return True
+                continue
+            if item is _SHUTDOWN or (isinstance(item, str) and item == "__rt_dag_stop__"):
+                self.out_ch.write("__rt_dag_stop__")
+                return True
+            try:
+                out = self.fn(item)
+            except Exception as e:  # propagate downstream as an error value
+                out = _StageError(repr(e))
+            self.out_ch.write(out)
+
+    def stop(self):
+        self._stop = True
+        return True
+
+
+class _StageError:
+    def __init__(self, msg: str):
+        self.msg = msg
+
+
+_SHUTDOWN = "__rt_dag_stop__"
+
+
+def _linearize(dag: DAGNode) -> list:
+    """Flatten a single-path function DAG (each node has exactly one
+    DAGNode/InputNode arg) into stage order."""
+    chain = []
+    node: Any = dag
+    while isinstance(node, DAGNode):
+        dag_args = [a for a in list(node.args) + list(node.kwargs.values())
+                    if isinstance(a, (DAGNode, InputNode))]
+        if len(dag_args) != 1:
+            raise ValueError(
+                "compiled DAGs support linear function pipelines in this "
+                "round (exactly one upstream per node)")
+        chain.append(node)
+        node = dag_args[0]
+    if not isinstance(node, InputNode):
+        raise ValueError("the pipeline root must consume InputNode")
+    return list(reversed(chain))
+
+
+class CompiledDAG:
+    def __init__(self, dag: DAGNode, *, channel_size: int = 1 << 20):
+        chain = _linearize(dag)
+        tag = uuid.uuid4().hex[:8]
+        n = len(chain)
+        # channels: driver -> s0 -> s1 -> ... -> driver
+        names = [f"{tag}_{i}" for i in range(n + 1)]
+        self._channels = [Channel(nm, channel_size) for nm in names]
+        self._in = self._channels[0]
+        self._out = self._channels[-1]
+        stage_cls = ray_tpu.remote(num_cpus=1, max_concurrency=2)(_StageActor)
+        self._actors = []
+        self._loops = []
+        for i, node in enumerate(chain):
+            fn = getattr(node.fn, "_fn", node.fn)
+            a = stage_cls.remote(fn, names[i], names[i + 1], channel_size)
+            self._actors.append(a)
+            self._loops.append(a.run_loop.remote())
+        self._dead = False
+
+    def execute(self, value, timeout: float = 60.0):
+        """One pipelined invocation: shm in, shm out — no per-call RPC."""
+        assert not self._dead, "compiled DAG was torn down"
+        self._in.write(value, timeout=timeout)
+        out = self._out.read(timeout=timeout)
+        if isinstance(out, _StageError):
+            raise RuntimeError(f"compiled DAG stage failed: {out.msg}")
+        return out
+
+    def teardown(self):
+        if self._dead:
+            return
+        self._dead = True
+        try:
+            self._in.write(_SHUTDOWN, timeout=5)
+            ray_tpu.get(self._loops, timeout=30)
+        except Exception:
+            pass
+        for a in self._actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass
+        for ch in self._channels:
+            ch.close(unlink=True)
+
+
+def compile(dag: DAGNode, **kw) -> CompiledDAG:  # noqa: A001 - reference name
+    return CompiledDAG(dag, **kw)

@@ -1,0 +1,102 @@
+"""Mutable shared-memory channels: the zero-copy low-latency substrate.
+
+Parity target: reference experimental/channel/shared_memory_channel.py
+backed by src/ray/core_worker/experimental_mutable_object_manager.h —
+fixed shm segments REUSED for every message, so steady-state transfer does
+no allocation, no RPC, and no scheduling. SPSC with a seq/ack pair in the
+header: the writer blocks until the reader acked the previous message
+(capacity-1 backpressure), the reader blocks until seq advances.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import pickle
+import struct
+import time
+
+# header: [seq: u64][ack: u64][size: u64]
+_HDR = struct.Struct("<QQQ")
+
+
+class Channel:
+    """One named SPSC channel over /dev/shm. Both ends open by name; the
+    handle pickles as (name, size) so it can ride task/actor args."""
+
+    def __init__(self, name: str, size: int = 1 << 20, _create: bool = True):
+        self.name = name
+        self.size = size
+        self._path = os.path.join("/dev/shm", f"rtch_{name}")
+        total = _HDR.size + size
+        exists = os.path.exists(self._path)
+        fd = os.open(self._path, os.O_CREAT | os.O_RDWR, 0o600)
+        try:
+            if not exists:
+                os.ftruncate(fd, total)
+            self._mm = mmap.mmap(fd, total)
+        finally:
+            os.close(fd)
+        # Reader joins at the ACK point: a message written before this end
+        # attached is still pending and must be delivered (the head would
+        # silently skip it and deadlock the backpressured writer).
+        self._last_read = self._ack()
+
+    # ------------------------------------------------------------- header
+    def _seq(self) -> int:
+        return _HDR.unpack_from(self._mm, 0)[0]
+
+    def _ack(self) -> int:
+        return _HDR.unpack_from(self._mm, 0)[1]
+
+    def _set(self, seq=None, ack=None, size=None):
+        s, a, z = _HDR.unpack_from(self._mm, 0)
+        _HDR.pack_into(self._mm, 0,
+                       s if seq is None else seq,
+                       a if ack is None else ack,
+                       z if size is None else size)
+
+    # -------------------------------------------------------------- write
+    def write(self, value, timeout: float | None = None):
+        blob = pickle.dumps(value, protocol=5)
+        if len(blob) > self.size:
+            raise ValueError(f"message {len(blob)}B > channel size {self.size}B")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        seq = self._seq()
+        # backpressure: previous message must be consumed
+        while self._ack() < seq:
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError("channel write timed out (reader stalled)")
+            time.sleep(0.000005)
+        self._mm[_HDR.size:_HDR.size + len(blob)] = blob
+        self._set(seq=seq + 1, size=len(blob))
+
+    # --------------------------------------------------------------- read
+    def read(self, timeout: float | None = None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            seq = self._seq()
+            if seq > self._last_read:
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError("channel read timed out")
+            time.sleep(0.000005)
+        size = _HDR.unpack_from(self._mm, 0)[2]
+        blob = bytes(self._mm[_HDR.size:_HDR.size + size])
+        self._last_read = seq
+        self._set(ack=seq)
+        return pickle.loads(blob)
+
+    def close(self, unlink: bool = False):
+        try:
+            self._mm.close()
+        except Exception:
+            pass
+        if unlink:
+            try:
+                os.unlink(self._path)
+            except OSError:
+                pass
+
+    def __reduce__(self):
+        return (Channel, (self.name, self.size, False))

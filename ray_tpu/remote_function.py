@@ -43,6 +43,13 @@ class RemoteFunction:
         self._options = dict(options or {})
         functools.update_wrapper(self, fn)
 
+    def bind(self, *args, **kwargs):
+        """DAG-node binding (reference dag API / workflow steps): builds a
+        lazy node whose args may be other bound nodes."""
+        from ray_tpu.workflow import bind as _wf_bind
+
+        return _wf_bind(self, *args, **kwargs)
+
     def options(self, **overrides) -> "RemoteFunction":
         bad = set(overrides) - _TASK_OPTION_KEYS
         if bad:

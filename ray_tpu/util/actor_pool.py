@@ -55,10 +55,23 @@ class ActorPool:
         idx = self._next_return_index
         if idx not in self._index_to_future:
             raise StopIteration("no pending results")
-        ref = self._index_to_future.pop(idx)
+        ref = self._index_to_future[idx]
+        from ray_tpu.exceptions import GetTimeoutError
+
+        try:
+            out = ray_tpu.get(ref, timeout=timeout)
+        except GetTimeoutError:
+            raise  # task still running: bookkeeping stays intact
+        except Exception:
+            # Task COMPLETED with an error: the actor is free again.
+            self._index_to_future.pop(idx, None)
+            self._next_return_index += 1
+            _i, actor = self._future_to_actor.pop(ref)
+            self._return_actor(actor)
+            raise
+        self._index_to_future.pop(idx, None)
         self._next_return_index += 1
         _i, actor = self._future_to_actor.pop(ref)
-        out = ray_tpu.get(ref, timeout=timeout)
         self._return_actor(actor)
         return out
 

@@ -39,8 +39,9 @@ class AsyncResult:
 
 
 class Pool:
-    """Task-backed process pool. `processes` caps in-flight parallelism
-    (cluster CPUs do the real limiting)."""
+    """Task-backed process pool. `processes` caps in-flight submissions on
+    the synchronous paths (map/starmap/imap*); the async paths submit
+    eagerly and rely on cluster CPUs for limiting."""
 
     def __init__(self, processes: Optional[int] = None):
         self._processes = processes
@@ -60,9 +61,31 @@ class Pool:
         assert not self._closed, "Pool is closed"
         return AsyncResult([self._run.remote(fn, tuple(args), kwds)], True)
 
+    def _windowed(self, submits: list) -> list:
+        """Run thunks with at most `processes` in flight."""
+        if not self._processes:
+            return [t() for t in submits]
+        out = [None] * len(submits)
+        in_flight: dict = {}
+        i = 0
+        while i < len(submits) or in_flight:
+            while i < len(submits) and len(in_flight) < self._processes:
+                out[i] = submits[i]()
+                in_flight[out[i]] = i
+                i += 1
+            if in_flight:
+                done, _ = ray_tpu.wait(list(in_flight), num_returns=1,
+                                       timeout=10)
+                for d in done:
+                    in_flight.pop(d, None)
+        return out
+
     def map(self, fn: Callable, iterable: Iterable,
             chunksize: Optional[int] = None) -> list:
-        return self.map_async(fn, iterable, chunksize).get()
+        assert not self._closed, "Pool is closed"
+        refs = self._windowed(
+            [lambda v=v: self._run.remote(fn, (v,), None) for v in iterable])
+        return ray_tpu.get(refs, timeout=None)
 
     def map_async(self, fn: Callable, iterable: Iterable,
                   chunksize: Optional[int] = None) -> AsyncResult:
@@ -72,8 +95,10 @@ class Pool:
 
     def starmap(self, fn: Callable, iterable: Iterable[tuple]) -> list:
         assert not self._closed, "Pool is closed"
-        refs = [self._run.remote(fn, tuple(v), None) for v in iterable]
-        return AsyncResult(refs, False).get()
+        refs = self._windowed(
+            [lambda v=v: self._run.remote(fn, tuple(v), None)
+             for v in iterable])
+        return ray_tpu.get(refs, timeout=None)
 
     def imap(self, fn: Callable, iterable: Iterable,
              chunksize: Optional[int] = None):

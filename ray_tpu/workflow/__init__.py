@@ -1,0 +1,141 @@
+"""ray_tpu.workflow — durable workflows with exactly-once step memoization.
+
+Parity target: reference python/ray/workflow (api.py run:123, resume;
+workflow continuation/checkpoint semantics over a DAG of tasks). A
+workflow is a DAG of `.bind()`ed remote functions; every step's result is
+checkpointed to storage under a deterministic step key, so `resume()` (or
+simply re-`run`ning the same workflow_id) skips completed steps — the
+recovery contract that makes long pipelines restartable.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+from typing import Any, Optional
+
+import ray_tpu
+
+_STORAGE = os.path.expanduser("~/ray_tpu_workflows")
+
+
+class DAGNode:
+    """A bound (fn, args, kwargs) node; args may contain other DAGNodes."""
+
+    def __init__(self, fn, args: tuple, kwargs: dict, name: str):
+        self.fn = fn
+        self.args = args
+        self.kwargs = kwargs
+        self.name = name
+
+
+def bind(remote_fn, *args, **kwargs) -> DAGNode:
+    """workflow-step binding for a @ray_tpu.remote function (also exposed
+    as RemoteFunction.bind)."""
+    inner = getattr(remote_fn, "_fn", remote_fn)
+    return DAGNode(remote_fn, args, kwargs,
+                   getattr(inner, "__name__", "step"))
+
+
+def init(storage: Optional[str] = None):
+    global _STORAGE
+    if storage:
+        _STORAGE = storage
+    os.makedirs(_STORAGE, exist_ok=True)
+
+
+def _step_dir(workflow_id: str) -> str:
+    return os.path.join(_STORAGE, workflow_id, "steps")
+
+
+def _step_key(node: DAGNode, child_keys: list[str]) -> str:
+    """Deterministic content key: function name + literal args + child step
+    keys. Same DAG -> same keys across runs, which is what memoization
+    keys on."""
+    h = hashlib.sha1()
+    h.update(node.name.encode())
+    for a in list(node.args) + sorted(node.kwargs.items()):
+        if isinstance(a, DAGNode):
+            continue  # covered by child_keys
+        try:
+            h.update(pickle.dumps(a))
+        except Exception:
+            h.update(repr(a).encode())
+    for ck in child_keys:
+        h.update(ck.encode())
+    return f"{node.name}-{h.hexdigest()[:16]}"
+
+
+def _run_node(node: Any, workflow_id: str, stats: dict):
+    if not isinstance(node, DAGNode):
+        return node, None
+    child_keys = []
+    args = []
+    for a in node.args:
+        v, ck = _run_node(a, workflow_id, stats)
+        args.append(v)
+        if ck:
+            child_keys.append(ck)
+    kwargs = {}
+    for k, a in node.kwargs.items():
+        v, ck = _run_node(a, workflow_id, stats)
+        kwargs[k] = v
+        if ck:
+            child_keys.append(ck)
+    key = _step_key(node, child_keys)
+    path = os.path.join(_step_dir(workflow_id), key + ".pkl")
+    if os.path.exists(path):
+        with open(path, "rb") as f:
+            stats["skipped"] += 1
+            return pickle.load(f), key
+    value = ray_tpu.get(node.fn.remote(*args, **kwargs), timeout=600)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        pickle.dump(value, f)
+    os.replace(tmp, path)  # atomic: a crash mid-write never half-memoizes
+    stats["executed"] += 1
+    return value, key
+
+
+def run(dag: DAGNode, *, workflow_id: str) -> Any:
+    """Execute the DAG durably; completed steps (from any earlier run of
+    this workflow_id) are skipped (reference workflow.run + resume)."""
+    init()
+    stats = {"executed": 0, "skipped": 0}
+    value, _ = _run_node(dag, workflow_id, stats)
+    meta = {"workflow_id": workflow_id, "status": "SUCCESSFUL", **stats}
+    with open(os.path.join(_STORAGE, workflow_id, "result.pkl"), "wb") as f:
+        pickle.dump({"value": value, "meta": meta}, f)
+    return value
+
+
+def resume(workflow_id: str, dag: Optional[DAGNode] = None) -> Any:
+    """Re-drive a workflow: with the DAG, identical to run (memoization
+    does the skipping); without it, return the stored final result."""
+    if dag is not None:
+        return run(dag, workflow_id=workflow_id)
+    path = os.path.join(_STORAGE, workflow_id, "result.pkl")
+    if not os.path.exists(path):
+        raise ValueError(f"workflow {workflow_id!r} has no stored result; "
+                         f"pass the DAG to resume execution")
+    with open(path, "rb") as f:
+        return pickle.load(f)["value"]
+
+
+def get_status(workflow_id: str) -> Optional[dict]:
+    path = os.path.join(_STORAGE, workflow_id, "result.pkl")
+    if not os.path.exists(path):
+        steps = _step_dir(workflow_id)
+        n = len(os.listdir(steps)) if os.path.isdir(steps) else 0
+        return {"workflow_id": workflow_id, "status": "RUNNING" if n else None,
+                "steps_done": n}
+    with open(path, "rb") as f:
+        return pickle.load(f)["meta"]
+
+
+def list_all() -> list[str]:
+    if not os.path.isdir(_STORAGE):
+        return []
+    return sorted(os.listdir(_STORAGE))

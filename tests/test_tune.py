@@ -129,7 +129,19 @@ def test_asha_stops_bad_half(ray_start_cluster, tmp_path):
 
 def _pbt_loop(config):
     """Score grows by `rate` per step; checkpoint carries the accumulated
-    score so exploit actually transfers progress."""
+    score so exploit actually transfers progress. A KV start barrier keeps
+    all trials concurrent — exploit decisions need live peers."""
+    import time as _time
+    import uuid as _uuid
+
+    from ray_tpu._private.worker import global_worker
+
+    w = global_worker()
+    w.kv("put", ns="test", key=f"pbt_gate/{_uuid.uuid4().hex}", value=b"1")
+    deadline = _time.monotonic() + 30
+    while (len(w.kv("keys", ns="test", prefix="pbt_gate/")["keys"])
+           < config["world"] and _time.monotonic() < deadline):
+        _time.sleep(0.02)
     score = 0.0
     step = 0
     ckpt = tune.get_checkpoint()
@@ -153,7 +165,8 @@ def test_pbt_exploits_good_trials(ray_start_4cpu, tmp_path):
         quantile_fraction=0.5, seed=0)  # bottom 2 of 4 exploit the top 2
     tuner = Tuner(
         _pbt_loop,
-        param_space={"rate": tune.grid_search([0.1, 0.2, 5.0, 6.0])},
+        param_space={"rate": tune.grid_search([0.1, 0.2, 5.0, 6.0]),
+                     "world": 4},
         tune_config=TuneConfig(metric="score", mode="max", scheduler=pbt),
         run_config=RunConfig(storage_path=str(tmp_path)),
     )
