@@ -34,7 +34,8 @@ logger = logging.getLogger(__name__)
 
 
 class _ObjectEntry:
-    __slots__ = ("state", "inline", "holders", "size", "waiters", "owner", "error")
+    __slots__ = ("state", "inline", "holders", "size", "waiters", "owner",
+                 "error", "escaped", "borrowers", "dying_at")
 
     def __init__(self):
         self.state = "pending"  # pending | ready | lost
@@ -44,6 +45,15 @@ class _ObjectEntry:
         self.waiters: list[asyncio.Future] = []
         self.owner: Optional[str] = None
         self.error = None  # serialized error blob (parts) shared with owner
+        # Borrower protocol (reference reference_count.h:72): an oid that
+        # ESCAPED its owner (was serialized into a payload another process
+        # can see) is not freed when the owner's refcount hits zero — it is
+        # marked dying and survives while registered borrowers exist, plus a
+        # grace TTL covering the in-flight window between the owner shipping
+        # the ref and the borrower registering.
+        self.escaped = False
+        self.borrowers: set[str] = set()  # worker ids holding borrowed refs
+        self.dying_at: Optional[float] = None  # owner freed; sweep after TTL
 
     def wake(self):
         for fut in self.waiters:
@@ -281,6 +291,16 @@ class Controller:
             asyncio.ensure_future(self._reap_owner_leases(wid))
             asyncio.ensure_future(
                 self._reap_owned_actors(wid, conn.meta.get("mode")))
+            asyncio.ensure_future(self._reap_borrows(wid))
+
+    async def _reap_borrows(self, wid: str):
+        """A dead borrower can never drop its borrows: remove it from every
+        borrower set; the dying-object sweep frees entries it was pinning
+        once their grace TTL passes."""
+        if not wid:
+            return
+        for ent in self.objects.values():
+            ent.borrowers.discard(wid)
 
     # ------------------------------------------------------- registration
     async def _h_register(self, conn, a):
@@ -953,8 +973,16 @@ class Controller:
         non-inline holder) — inline results (every small task/actor return)
         never touch /dev/shm, and purging them on every node made the agent
         glob shm per freed oid. Tombstones catch the advertise-vs-free race:
-        a register that lands after the free must not resurrect the entry."""
+        a register that lands after the free must not resurrect the entry.
+
+        Escaped oids (listed in a["escaped"], or marked on the entry) get
+        borrower-protocol semantics instead: the entry is marked dying and
+        survives until no borrowers remain and a grace TTL has passed
+        (_sweep_dying) — the owner's local refcount hitting zero must not
+        yank an object another process borrowed (reference
+        reference_count.h borrower protocol)."""
         oids = a["oids"]
+        escaped = set(a.get("escaped") or ())
         now = time.monotonic()
         if self.freed_tombstones and now > self._tombstone_prune_at:
             self._tombstone_prune_at = now + 10.0
@@ -962,7 +990,14 @@ class Controller:
                 o: t for o, t in self.freed_tombstones.items() if t > now}
         shm_oids = []
         for oid in oids:
-            ent = self.objects.pop(oid, None)
+            ent = self.objects.get(oid)
+            if oid in escaped or (ent is not None and ent.escaped):
+                ent = self.objects.setdefault(oid, _ObjectEntry())
+                ent.escaped = True
+                if ent.dying_at is None:
+                    ent.dying_at = now + CONFIG.borrowed_free_grace_s
+                continue
+            self.objects.pop(oid, None)
             # TTL must exceed any plausible task runtime: a fire-and-forget
             # task finishing after the tombstone expires would resurrect the
             # entry (and pin its shm segment forever).
@@ -973,12 +1008,58 @@ class Controller:
             for o in list(self.freed_tombstones)[:100_000]:
                 self.freed_tombstones.pop(o, None)
         if shm_oids:
-            for nconn in self.node_conns.values():
-                if not nconn.closed:
-                    try:
-                        await nconn.push("free", oids=shm_oids)
-                    except Exception:
-                        pass
+            await self._purge_on_agents(shm_oids)
+
+    async def _purge_on_agents(self, shm_oids: list[str]):
+        for nconn in self.node_conns.values():
+            if not nconn.closed:
+                try:
+                    await nconn.push("free", oids=shm_oids)
+                except Exception:
+                    pass
+
+    async def _p_borrow_add(self, conn, a):
+        """A process materialized a borrowed ref: pin the entry while the
+        borrower lives (keeps a dying escaped entry alive past its TTL)."""
+        if self._freed(a["oid"]):
+            # The object is already gone (grace expired / non-escaped free):
+            # don't resurrect a permanently-pending entry — the borrower's
+            # get() will surface 'lost' via the tombstone.
+            return
+        ent = self.objects.setdefault(a["oid"], _ObjectEntry())
+        ent.escaped = True
+        ent.borrowers.add(a["worker_id"])
+
+    async def _p_borrow_drop(self, conn, a):
+        ent = self.objects.get(a["oid"])
+        if ent is None:
+            return
+        ent.borrowers.discard(a["worker_id"])
+        # Even with no borrowers left, the entry must survive until its
+        # grace TTL: another borrow registration may still be in flight
+        # (that window is the whole reason dying_at exists). The health
+        # loop's _sweep_dying reaps it at the TTL.
+
+    async def _free_escaped(self, oids: list[str]):
+        now = time.monotonic()
+        shm_oids = []
+        for oid in oids:
+            ent = self.objects.pop(oid, None)
+            self.freed_tombstones[oid] = now + 600.0
+            if ent is not None and ent.inline is None and ent.holders:
+                shm_oids.append(oid)
+        if shm_oids:
+            await self._purge_on_agents(shm_oids)
+
+    async def _sweep_dying(self):
+        """Reap owner-freed escaped entries whose grace TTL expired with no
+        registered borrowers (runs from the health loop)."""
+        now = time.monotonic()
+        expired = [oid for oid, ent in self.objects.items()
+                   if ent.dying_at is not None and now >= ent.dying_at
+                   and not ent.borrowers]
+        if expired:
+            await self._free_escaped(expired)
 
     def _freed(self, oid: str) -> bool:
         t = self.freed_tombstones.get(oid)
@@ -1276,6 +1357,10 @@ class Controller:
             for nid, node in list(self.nodes.items()):
                 if node.alive and node.last_beat and now - node.last_beat > timeout:
                     await self._node_died(nid)
+            try:
+                await self._sweep_dying()
+            except Exception:
+                logger.exception("dying-object sweep failed")
 
     # ----------------------------------------------------- placement groups
     async def _h_create_pg(self, conn, a):

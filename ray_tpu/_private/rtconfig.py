@@ -44,6 +44,11 @@ _flag("prestart_workers", bool, True)
 _flag("idle_worker_keep_s", float, 300.0)
 _flag("scheduler_spread_threshold", float, 0.5)  # hybrid policy pack->spread knob
 _flag("lineage_reconstruction_enabled", bool, True)
+# Borrower protocol: how long an owner-freed ESCAPED object survives at the
+# controller waiting for a borrower to register (covers the in-flight window
+# between the owner shipping a ref inside a payload and the receiving process
+# materializing it; cf. reference reference_count.h borrower handshake).
+_flag("borrowed_free_grace_s", float, 60.0)
 _flag("max_pending_calls_default", int, -1)
 _flag("log_to_driver", bool, True)
 # Fixed-point resource arithmetic granularity (reference fixed_point.h uses 1e-4).

@@ -16,7 +16,9 @@ ReferenceCounter borrower protocol reference_count.h:72).
 
 from __future__ import annotations
 
+import io
 import pickle
+import struct
 from dataclasses import dataclass
 
 import cloudpickle
@@ -37,6 +39,10 @@ class SerializedObject:
         [header][ (8B len, raw)* ]. Contained refs are stored by id so a
         deserializer in another process can re-hydrate borrowed ObjectRefs.
         Single source of truth for the layout is to_parts()."""
+        if not self.buffers and not self.contained_refs:
+            # Tiny-result fast path (every scalar actor/task return):
+            # [nrefs=0][nbufs=0][hlen][header] in one concat.
+            return struct.pack("<IIQ", 0, 0, len(self.header)) + self.header
         return b"".join(
             p if isinstance(p, (bytes, bytearray)) else bytes(p)
             for p in self.to_parts())
@@ -46,8 +52,6 @@ class SerializedObject:
         store can write each raw buffer straight into the mmap — one copy
         total on the put path (reference plasma writes once into shm;
         round-1 joined everything first = two extra full copies)."""
-        import struct
-
         ref_oids = [r.hex() if hasattr(r, "hex") else r for r in self.contained_refs]
         meta = [struct.pack("<I", len(ref_oids))]
         for h in ref_oids:
@@ -66,8 +70,6 @@ class SerializedObject:
     def from_buffer(buf) -> "SerializedObject":
         """Zero-copy parse from a contiguous blob (memoryview over shm).
         `contained_refs` comes back as a list of oid hex strings."""
-        import struct
-
         mv = memoryview(buf)
         (nrefs,) = struct.unpack_from("<I", mv, 0)
         off = 4
@@ -99,7 +101,45 @@ class _RefPlaceholder:
         self.index = index
 
 
+class _RefPickler(cloudpickle.Pickler):
+    """cloudpickle pickler that swaps ObjectRefs for persistent ids."""
+
+    def __init__(self, f, ref_class, contained_refs, **kw):
+        super().__init__(f, **kw)
+        self._ref_class = ref_class
+        self._contained_refs = contained_refs
+
+    def persistent_id(self, obj):  # noqa: N802
+        if isinstance(obj, self._ref_class):
+            self._contained_refs.append(obj)
+            return ("rt_ref", len(self._contained_refs) - 1)
+        return None
+
+
+class _RefUnpickler(pickle.Unpickler):
+    def __init__(self, f, resolve_ref, **kw):
+        super().__init__(f, **kw)
+        self._resolve_ref = resolve_ref
+
+    def persistent_load(self, pid):  # noqa: N802
+        tag, idx = pid
+        if tag == "rt_ref" and self._resolve_ref is not None:
+            return self._resolve_ref(idx)
+        raise pickle.UnpicklingError(f"unknown persistent id {pid}")
+
+
+# Exact types that can never contain an ObjectRef (or an oob buffer):
+# results of this shape skip the cloudpickle ref-scanning pickler entirely —
+# the dominant case for actor-method replies (None / status scalars).
+_ATOMIC_TYPES = (type(None), bool, int, float)
+
+
 def serialize(value, ref_class=None) -> SerializedObject:
+    t = type(value)
+    if t in _ATOMIC_TYPES or (t in (str, bytes) and len(value) < 4096):
+        return SerializedObject(
+            header=pickle.dumps(value, protocol=5), buffers=[], contained_refs=[])
+
     buffers: list = []
     contained_refs: list = []
 
@@ -108,18 +148,9 @@ def serialize(value, ref_class=None) -> SerializedObject:
         return False  # out-of-band
 
     if ref_class is not None:
-
-        class _Pickler(cloudpickle.Pickler):
-            def persistent_id(self, obj):  # noqa: N802
-                if isinstance(obj, ref_class):
-                    contained_refs.append(obj)
-                    return ("rt_ref", len(contained_refs) - 1)
-                return None
-
-        import io
-
         f = io.BytesIO()
-        p = _Pickler(f, protocol=5, buffer_callback=buffer_callback)
+        p = _RefPickler(f, ref_class, contained_refs, protocol=5,
+                        buffer_callback=buffer_callback)
         p.dump(value)
         header = f.getvalue()
     else:
@@ -129,17 +160,10 @@ def serialize(value, ref_class=None) -> SerializedObject:
 
 def deserialize(sobj: SerializedObject, resolve_ref=None):
     """resolve_ref(index) -> ObjectRef for persistent-id re-hydration."""
-
-    class _Unpickler(pickle.Unpickler):
-        def persistent_load(self, pid):  # noqa: N802
-            tag, idx = pid
-            if tag == "rt_ref" and resolve_ref is not None:
-                return resolve_ref(idx)
-            raise pickle.UnpicklingError(f"unknown persistent id {pid}")
-
-    import io
-
-    up = _Unpickler(io.BytesIO(sobj.header), buffers=sobj.buffers)
+    if not sobj.contained_refs:
+        # No persistent ids in the stream: C-level loads, no Unpickler object.
+        return pickle.loads(sobj.header, buffers=sobj.buffers)
+    up = _RefUnpickler(io.BytesIO(sobj.header), resolve_ref, buffers=sobj.buffers)
     return up.load()
 
 

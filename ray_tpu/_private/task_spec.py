@@ -9,7 +9,7 @@ python/ray/_private/function_manager.py export/import via GCS KV).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Any, Optional
+from typing import Any, ClassVar, Optional
 
 NORMAL = "normal"
 ACTOR_CREATE = "actor_create"
@@ -112,8 +112,69 @@ class TaskSpec:
         new.strategy = ns
         return new
 
-    def return_object_ids(self) -> list[str]:
-        from ray_tpu._private.ids import ObjectID, TaskID
+    # Strategy shared by every actor-call spec: actor tasks never visit the
+    # scheduler (they ride the actor pipe straight to the bound worker), so
+    # nothing ever mutates it.
+    _ACTOR_CALL_STRATEGY: ClassVar["SchedulingStrategy"] = None  # set below
 
-        tid = TaskID.from_hex(self.task_id)
-        return [ObjectID.for_task_return(tid, i).hex() for i in range(self.num_returns)]
+    @classmethod
+    def for_actor_call(cls, task_id: str, method_name: str, args, kwargs,
+                       num_returns: int, name: str, owner_id: str,
+                       owner_addr, actor_id: str, attempt: int = 0) -> "TaskSpec":
+        """Cheap constructor for the actor hot path: skips dataclass default
+        factories (~3us/call at n:n rates) and shares one strategy object."""
+        sp = object.__new__(cls)
+        sp.task_id = task_id
+        sp.kind = ACTOR_TASK
+        sp.name = name
+        sp.function_id = ""
+        sp.method_name = method_name
+        sp.args = args
+        sp.kwargs = kwargs
+        sp.num_returns = num_returns
+        sp.resources = {}
+        sp.strategy = cls._ACTOR_CALL_STRATEGY
+        sp.max_retries = 0
+        sp.retry_exceptions = False
+        sp.runtime_env = {}
+        sp.owner_id = owner_id
+        sp.owner_addr = owner_addr
+        sp.actor_id = actor_id
+        sp.max_restarts = 0
+        sp.max_task_retries = 0
+        sp.max_concurrency = 1
+        sp.actor_name = None
+        sp.namespace = "default"
+        sp.get_if_exists = False
+        sp.lifetime = None
+        sp.attempt = attempt
+        return sp
+
+    def actor_call_tuple(self) -> tuple:
+        """Compact wire record for `actor_calls` frames — the full 24-field
+        spec pickle costs ~9us/call encode+decode and 293B; this is ~1/3 of
+        both. Frame-constant fields (owner, actor id) ride once per frame."""
+        return (self.task_id, self.method_name, self.args, self.kwargs,
+                self.num_returns, self.name, self.attempt)
+
+    def return_object_ids(self) -> list[str]:
+        # Object id hex = task id hex + 4B little-endian return index hex
+        # (ids.ObjectID.for_task_return) — derivable by string concat, which
+        # matters: this runs once per call on both submitter and executor.
+        n = self.num_returns
+        if n == 1:
+            return [self.task_id + "00000000"]
+        tid = self.task_id
+        return [tid + i.to_bytes(4, "little").hex() for i in range(n)]
+
+
+TaskSpec._ACTOR_CALL_STRATEGY = SchedulingStrategy()
+
+
+def actor_call_spec(call: tuple, owner_id: str, owner_addr, actor_id: str) -> TaskSpec:
+    """Rebuild an executor-side spec from an `actor_calls` wire record."""
+    task_id, method_name, args, kwargs, num_returns, name, attempt = call
+    return TaskSpec.for_actor_call(
+        task_id, method_name, args, kwargs, num_returns, name,
+        owner_id, tuple(owner_addr) if owner_addr else None, actor_id,
+        attempt=attempt)

@@ -49,16 +49,23 @@ class ObjectRef:
     """A future for an object in the cluster (reference: ObjectRef in
     python/ray/includes/object_ref.pxi; ownership semantics from
     reference_count.h:72 — only the owner process refcounts; deserialized
-    copies are borrowed and do not affect lifetime in round 1)."""
+    copies are BORROWED and pin the object at the controller via the
+    borrower protocol (borrow_add/borrow_drop) until dropped)."""
 
-    __slots__ = ("_oid", "_owned", "_worker", "__weakref__")
+    __slots__ = ("_oid", "_owned", "_worker", "_borrow", "__weakref__")
 
-    def __init__(self, oid: str, owned: bool = False, worker: "Worker" = None):
+    def __init__(self, oid: str, owned: bool = False, worker: "Worker" = None,
+                 borrow: bool = False):
         self._oid = oid
         self._owned = owned
         self._worker = worker
+        self._borrow = False
         if owned and worker is not None:
             worker._incref(oid)
+        elif borrow and worker is not None:
+            # Registers with the controller (deduped per process); False for
+            # oids this process owns anyway.
+            self._borrow = worker._borrow_incref(oid)
 
     def hex(self) -> str:
         return self._oid
@@ -79,9 +86,12 @@ class ObjectRef:
         return f"ObjectRef({self._oid[:16]})"
 
     def __del__(self):
-        if self._owned and self._worker is not None:
+        if self._worker is not None:
             try:
-                self._worker._decref(self._oid)
+                if self._owned:
+                    self._worker._decref(self._oid)
+                elif self._borrow:
+                    self._worker._borrow_decref(self._oid)
             except Exception:
                 pass
 
@@ -107,17 +117,25 @@ class ObjectRef:
 
 
 def _borrowed_ref(oid: str) -> ObjectRef:
-    return ObjectRef(oid, owned=False, worker=global_worker())
+    return ObjectRef(oid, owned=False, worker=global_worker(), borrow=True)
 
 
 _watchers_lock = threading.Lock()
 
 
 class _Resolution:
-    __slots__ = ("event", "inline", "holders", "error", "watchers")
+    """Per-object resolution slot.
+
+    The blocking Event is created LAZILY by the first waiter that actually
+    has to block: in pipelined/async workloads most results arrive before
+    get() looks at them, and a threading.Event costs a Condition + Lock
+    allocation — measurable at tens of thousands of calls/s on one core."""
+
+    __slots__ = ("done", "event", "inline", "holders", "error", "watchers")
 
     def __init__(self):
-        self.event = threading.Event()
+        self.done = False
+        self.event = None  # lazily-created by a blocking waiter
         self.inline = None
         self.holders: list = []
         self.error = None
@@ -129,20 +147,46 @@ class _Resolution:
         against resolve()'s swap so a callback can never be lost or run
         twice."""
         with _watchers_lock:
-            if self.event.is_set():
+            if self.done:
                 return False
             if self.watchers is None:
                 self.watchers = []
             self.watchers.append(cb)
             return True
 
+    def wait(self, timeout=None) -> bool:
+        if self.done:
+            return True
+        with _watchers_lock:
+            if self.done:
+                return True
+            ev = self.event
+            if ev is None:
+                ev = self.event = threading.Event()
+        return ev.wait(timeout)
+
+    def remove_watcher(self, cb):
+        """Deregister a watcher added by add_watcher (no-op if it already
+        ran or was cleared by resolve)."""
+        with _watchers_lock:
+            if self.watchers is not None:
+                try:
+                    self.watchers.remove(cb)
+                except ValueError:
+                    pass
+
     def resolve(self, inline, holders, error):
+        # Values are published BEFORE done flips; the GIL orders these for
+        # readers that check `done` without the lock.
         self.inline = inline
         self.holders = holders or []
         self.error = error
-        self.event.set()
         with _watchers_lock:
+            self.done = True
+            ev = self.event
             ws, self.watchers = self.watchers, None
+        if ev is not None:
+            ev.set()
         for cb in ws or ():
             try:
                 cb()
@@ -152,10 +196,13 @@ class _Resolution:
     def reset(self):
         """Re-arm in place (reconstruction): getters already blocked on
         `event` keep waiting on THIS object, so it must not be replaced."""
-        self.inline = None
-        self.holders = []
-        self.error = None
-        self.event.clear()
+        with _watchers_lock:
+            self.inline = None
+            self.holders = []
+            self.error = None
+            self.done = False
+            if self.event is not None:
+                self.event.clear()
 
 
 _global_worker: Optional["Worker"] = None
@@ -192,8 +239,17 @@ class Worker:
         self._refcounts: dict[str, int] = {}
         self._refcounts_lock = threading.Lock()
         self._free_buf: list[str] = []
+        self._free_escaped_buf: list[str] = []
         self._free_scheduled = False
+        # Borrowed-ref pins held by this process: oid -> local borrow count.
+        # The controller learns only the 0<->1 transitions.
+        self._borrows: dict[str, int] = {}
+        self._borrows_lock = threading.Lock()
         self._escaped: set[str] = set()  # owned oids advertised on escape
+        # Oids whose resolution came FROM the controller (queued-path
+        # object_ready / object_lost): the controller holds directory state
+        # for these, so their free must reach it (see _free fast path).
+        self._ctrl_resolved: set[str] = set()
         self._resolutions: dict[str, _Resolution] = {}
         self._inline_cache: dict[str, list] = {}  # oid -> blob parts (small objs)
         self._lineage: dict[str, TaskSpec] = {}  # return oid -> producing spec
@@ -214,6 +270,7 @@ class Worker:
         self._submit_flushing = False
         # Hook used by worker_proc to execute actor calls in-order:
         self.actor_push_handler = None  # def (conn, spec)
+        self.actor_batch_handler = None  # def (conn, list[spec]) — one frame
         # Hooks used by worker_proc for the direct (leased) task path:
         self.task_push_handler = None  # def (conn, spec) — enqueue for exec
         self.task_cancel_handler = None  # def (task_id)
@@ -305,7 +362,16 @@ class Worker:
             if self.task_push_handler is not None:
                 for spec in a["specs"]:
                     self.task_push_handler(conn, spec)
-        elif method == "actor_tasks":
+        elif method == "actor_calls":
+            if self.actor_batch_handler is not None:
+                owner_id, owner_addr, actor_id = a["common"]
+                owner_addr = tuple(owner_addr) if owner_addr else None
+                self.actor_batch_handler(conn, [
+                    TaskSpec.for_actor_call(
+                        c[0], c[1], c[2], c[3], c[4], c[5],
+                        owner_id, owner_addr, actor_id, attempt=c[6])
+                    for c in a["calls"]])
+        elif method == "actor_tasks":  # full-spec form (compat)
             if self.actor_push_handler is not None:
                 for spec in a["specs"]:
                     self.actor_push_handler(conn, spec)
@@ -319,6 +385,7 @@ class Worker:
         elif method == "need_resources":
             self.lease_mgr.on_need_resources()
         elif method == "object_ready":
+            self._ctrl_resolved.add(a["oid"])
             res = self._resolutions.setdefault(a["oid"], _Resolution())
             res.resolve(a.get("inline"), [tuple(h) for h in a.get("holders", [])], a.get("error"))
         elif method == "worker_log":
@@ -333,6 +400,7 @@ class Worker:
             # All copies died with a node. Reconstruct from lineage if we can
             # (reference object_recovery_manager.cc:26), else fail waiters.
             oid = a["oid"]
+            self._ctrl_resolved.add(oid)
             if not self._maybe_reconstruct_async(oid):
                 h, bufs = dumps_oob({"type": "ObjectLostError",
                                      "message": f"object {oid[:16]} lost (node died)"})
@@ -358,18 +426,88 @@ class Worker:
         if free:
             self._free([oid])
 
+    def _borrow_incref(self, oid: str) -> bool:
+        """Register this process as a borrower of an oid it does not own.
+        Returns True iff a borrow pin was actually taken (the matching
+        __del__ must then drop it)."""
+        if oid in self._resolutions or self._shutdown:
+            return False  # our own object round-tripping back — not a borrow
+        # The push happens UNDER the lock: add/drop frames must reach the
+        # (ordered) controller connection in the same order as the local
+        # 0<->1 transitions, or a drop can cancel a newer add.
+        with self._borrows_lock:
+            c = self._borrows.get(oid, 0)
+            self._borrows[oid] = c + 1
+            if c == 0:
+                try:
+                    self.controller.push_threadsafe(
+                        "borrow_add", oid=oid, worker_id=self.worker_id)
+                except Exception:
+                    pass
+        return True
+
+    def _borrow_decref(self, oid: str):
+        if self._shutdown:
+            return
+        with self._borrows_lock:
+            c = self._borrows.get(oid, 0) - 1
+            if c <= 0:
+                self._borrows.pop(oid, None)
+                try:
+                    self.controller.push_threadsafe(
+                        "borrow_drop", oid=oid, worker_id=self.worker_id)
+                except Exception:
+                    pass
+            else:
+                self._borrows[oid] = c
+
     def _free(self, oids: list[str]):
+        remote: list[str] = []
+        escaped_oids: list[str] = []
         for oid in oids:
             self._inline_cache.pop(oid, None)
-            self._resolutions.pop(oid, None)
+            escaped = oid in self._escaped
+            ctrl = oid in self._ctrl_resolved
+            if ctrl:
+                self._ctrl_resolved.discard(oid)
+            if escaped:
+                self._escaped.discard(oid)
+                res = self._resolutions.get(oid)
+                if res is None or res.done or not res.add_watcher(
+                        lambda o=oid: self._resolutions.pop(o, None)):
+                    # Resolved (possibly between the check and add_watcher —
+                    # registration failing means resolve already ran): the
+                    # escape advertise has fired, pop now.
+                    # Unresolved: the add_watcher above keeps the resolution
+                    # until the producing task finishes, so the escape
+                    # advertise can still reach the controller; watchers run
+                    # in registration order, advertise before this pop.
+                    self._resolutions.pop(oid, None)
+                self._lineage.pop(oid, None)
+                escaped_oids.append(oid)
+                remote.append(oid)
+                continue
+            res = self._resolutions.pop(oid, None)
             self._lineage.pop(oid, None)
-            self._escaped.discard(oid)
+            # Purely-local object: resolved from a direct (lease/actor-pipe)
+            # reply inline, never escaped this process, controller never
+            # heard of it — its free is a no-op everywhere else, so don't
+            # spend a controller frame + tombstone on it. This is the common
+            # case for every small task/actor return consumed by its owner.
+            if (not ctrl and res is not None and res.done
+                    and not res.holders):
+                continue
             self.store.delete(oid)
+            remote.append(oid)
+        if not remote:
+            return
+        oids = remote
         # Batch the controller notification: refs die one at a time (GC),
         # but a burst of dying refs (the common teardown of a get() over
         # many results) must not cost one controller frame each.
         with self._refcounts_lock:
             self._free_buf.extend(oids)
+            self._free_escaped_buf.extend(escaped_oids)
             need = not self._free_scheduled
             self._free_scheduled = True
         if need:
@@ -385,10 +523,12 @@ class Worker:
         await asyncio.sleep(0.002)  # coalesce the burst
         with self._refcounts_lock:
             oids, self._free_buf = self._free_buf, []
+            escaped, self._free_escaped_buf = self._free_escaped_buf, []
             self._free_scheduled = False
         if oids and not self._shutdown:
             try:
-                await self.controller.push("free_objects", oids=oids)
+                await self.controller.push("free_objects", oids=oids,
+                                           escaped=escaped)
             except Exception:
                 pass
 
@@ -448,7 +588,7 @@ class Worker:
         # 1. owned refs already resolved: straight to materialize (the hot
         # path for harvesting a batch of results — skips two cache probes)
         res = self._resolutions.get(oid)
-        if res is not None and res.event.is_set():
+        if res is not None and res.done:
             return self._materialize(oid, res.inline, res.holders, res.error, deadline)
         # 2. local caches (in-process inline / same-host shm, zero-copy)
         val, found = self._try_local(oid)
@@ -456,7 +596,7 @@ class Worker:
             return val
         # 3. owned refs: wait for the controller's object_ready push
         if res is not None:
-            if not res.event.wait(timeout=self._remaining(deadline)):
+            if not res.wait(timeout=self._remaining(deadline)):
                 raise exc.GetTimeoutError(f"get() timed out on {oid[:16]}")
             return self._materialize(oid, res.inline, res.holders, res.error, deadline)
         # 3. borrowed refs: ask the controller directly
@@ -565,7 +705,8 @@ class Worker:
         # contained_refs are ObjectRef instances (fresh from serialize()) or
         # oid hex strings (parsed from a flattened blob) — re-hydrate either.
         refs = [
-            r if isinstance(r, ObjectRef) else ObjectRef(r, owned=False, worker=self)
+            r if isinstance(r, ObjectRef)
+            else ObjectRef(r, owned=False, worker=self, borrow=True)
             for r in sobj.contained_refs
         ]
         return deserialize(sobj, resolve_ref=lambda idx: refs[idx])
@@ -596,39 +737,91 @@ class Worker:
 
     # ---------------------------------------------------------------- wait
     def wait(self, refs: list[ObjectRef], num_returns: int = 1, timeout: float | None = None):
+        """Event-driven wait (reference raylet/wait_manager.h is similarly
+        notification-based): owned refs hook resolution watchers and sleep on
+        one Event — no polling, no controller traffic. Only refs owned by
+        ANOTHER process (no local resolution slot) fall back to polling the
+        controller's bulk readiness probe."""
         if num_returns > len(refs):
             raise ValueError("num_returns > len(refs)")
         deadline = None if timeout is None else time.monotonic() + timeout
-        pending = list(refs)
         ready: list[ObjectRef] = []
-        while True:
-            still = []
-            for r in pending:
-                if self._is_ready_local(r.hex()):
-                    ready.append(r)
+        owned_pending: list[ObjectRef] = []
+        borrowed_pending: list[ObjectRef] = []
+        for r in refs:
+            oid = r.hex()
+            if self._is_ready_local(oid):
+                ready.append(r)
+            elif oid in self._resolutions:
+                owned_pending.append(r)
+            else:
+                borrowed_pending.append(r)
+        if len(ready) >= num_returns or not (owned_pending or borrowed_pending):
+            return ready, owned_pending + borrowed_pending
+        ev = threading.Event()
+        hits: list[ObjectRef] = []
+        hits_lock = threading.Lock()
+        live = [True]  # watchers outlive this call; dead-man switch
+
+        def _mk_cb(r):
+            def cb():
+                if live[0]:
+                    with hits_lock:
+                        hits.append(r)
+                    ev.set()
+            return cb
+
+        registered: list[tuple] = []  # (res, cb) to deregister on exit
+        try:
+            for r in owned_pending:
+                res = self._resolutions.get(r.hex())
+                cb = _mk_cb(r)
+                if res is None or not res.add_watcher(cb):
+                    cb()  # resolved between classification and registration
                 else:
-                    still.append(r)
-            pending = still
-            if len(ready) >= num_returns or not pending:
-                break
-            if pending:
-                oids = [r.hex() for r in pending]
-                rep = self.io.run(self.controller.call("check_objects", oids=oids))
-                newly = [r for r, ok in zip(pending, rep["ready"]) if ok]
-                ready.extend(newly)
-                pending = [r for r, ok in zip(pending, rep["ready"]) if not ok]
-            if len(ready) >= num_returns or not pending:
-                break
-            if deadline is not None and time.monotonic() >= deadline:
-                break
-            time.sleep(0.005)
-        return ready, pending
+                    registered.append((res, cb))
+            owned_waiting = set(owned_pending)
+            while True:
+                with hits_lock:
+                    newly, hits[:] = list(hits), []
+                for r in newly:
+                    if r in owned_waiting:
+                        owned_waiting.discard(r)
+                        ready.append(r)
+                if len(ready) >= num_returns or not (owned_waiting or borrowed_pending):
+                    break
+                if borrowed_pending:
+                    oids = [r.hex() for r in borrowed_pending]
+                    rep = self.io.run(self.controller.call("check_objects", oids=oids))
+                    newly_b = [r for r, ok in zip(borrowed_pending, rep["ready"]) if ok]
+                    ready.extend(newly_b)
+                    borrowed_pending = [
+                        r for r, ok in zip(borrowed_pending, rep["ready"]) if not ok]
+                    if len(ready) >= num_returns or not (owned_waiting or borrowed_pending):
+                        break
+                rem = None if deadline is None else deadline - time.monotonic()
+                if rem is not None and rem <= 0:
+                    break
+                # With borrowed refs in play we must re-poll the controller;
+                # otherwise sleep until a watcher fires (or timeout).
+                if borrowed_pending:
+                    rem = 0.005 if rem is None else min(rem, 0.005)
+                ev.wait(rem)
+                ev.clear()
+        finally:
+            live[0] = False
+            # Deregister un-fired watchers: a caller polling wait() in a
+            # loop against a slow task must not grow the resolution's
+            # watcher list (and pin refs) on every call.
+            for res, cb in registered:
+                res.remove_watcher(cb)
+        return ready, [r for r in owned_pending if r in owned_waiting] + borrowed_pending
 
     def _is_ready_local(self, oid: str) -> bool:
         if oid in self._inline_cache or self.store.contains(oid):
             return True
         res = self._resolutions.get(oid)
-        return res is not None and res.event.is_set()
+        return res is not None and res.done
 
     # --------------------------------------------------------- submit task
     def _register_function(self, fn) -> str:
@@ -916,19 +1109,9 @@ class Worker:
         enc_args, enc_kwargs, escapes = (self._encode_args(args, kwargs)
                                          if (args or kwargs) else ([], {}, []))
         task_id = TaskID.from_random().hex()
-        spec = TaskSpec(
-            task_id=task_id,
-            kind=ACTOR_TASK,
-            name=name or method_name,
-            function_id="",
-            method_name=method_name,
-            args=enc_args,
-            kwargs=enc_kwargs,
-            num_returns=num_returns,
-            owner_id=self.worker_id,
-            owner_addr=self.server_addr,
-            actor_id=actor_id,
-        )
+        spec = TaskSpec.for_actor_call(
+            task_id, method_name, enc_args, enc_kwargs, num_returns,
+            name or method_name, self.worker_id, self.server_addr, actor_id)
         refs = []
         for oid in spec.return_object_ids():
             self._resolutions[oid] = _Resolution()
@@ -1026,7 +1209,13 @@ class _ActorPipe:
             for spec, retries, seq in batch:
                 self.inflight[spec.task_id] = (spec, retries, seq)
             try:
-                await self.conn.push("actor_tasks", specs=[b[0] for b in batch])
+                # Compact wire form: frame-constant owner/actor fields ride
+                # once, per-call fields as tuples (~3x cheaper than full
+                # 24-field spec pickles at n:n call rates).
+                await self.conn.push(
+                    "actor_calls",
+                    common=(self.w.worker_id, self.w.server_addr, self.actor_id),
+                    calls=[b[0].actor_call_tuple() for b in batch])
             except Exception:
                 pass  # close handler redistributes inflight; loop reconnects
 

@@ -88,6 +88,7 @@ class WorkerProc:
         self.exec_queue: "queue.Queue" = queue.Queue()
         self.agent_conn: rpc.Connection | None = None
         self.actor_instance = None
+        self._method_cache: dict = {}  # method name -> (bound method, is_coro)
         self.actor_id: str | None = None
         self.actor_max_concurrency = 1
         self._actor_pool = None  # ThreadPoolExecutor for threaded actors
@@ -109,6 +110,7 @@ class WorkerProc:
         self.worker.connect()
         set_global_worker(self.worker)
         self.worker.actor_push_handler = self._on_actor_push
+        self.worker.actor_batch_handler = self._on_actor_batch
         self.worker.task_push_handler = self._on_task_push
         self.worker.task_cancel_handler = self._cancel_current
         # Long-lived pool workers serve many lease holders; drop a holder's
@@ -165,13 +167,13 @@ class WorkerProc:
     def _on_actor_push(self, conn, spec: TaskSpec):
         """Pipelined actor call (runs on the IO loop): execute in arrival
         order, reply via the per-connection batched pusher."""
-        pusher = self._pusher_for(conn)
+        self.exec_queue.put(("actor_batch", [spec], self._pusher_for(conn)))
 
-        def reply_cb(reply: dict, _p=pusher, _tid=spec.task_id):
-            if _p is not None:
-                _p.add({**reply, "task_id": _tid})
-
-        self.exec_queue.put(("actor_task", spec, reply_cb))
+    def _on_actor_batch(self, conn, specs: list):
+        """A whole coalesced actor_calls frame rides ONE exec-queue item:
+        at n:n call rates the per-call queue put/get + condition notify was
+        a measurable share of the worker's core budget."""
+        self.exec_queue.put(("actor_batch", specs, self._pusher_for(conn)))
 
     def _cancel_current(self, task_id: str):
         """Non-force cancel: raise KeyboardInterrupt in the executing thread
@@ -225,8 +227,12 @@ class WorkerProc:
             try:
                 if kind == "ltask":
                     self._execute_leased_task(spec, reply_slot)
+                elif kind == "actor_batch":
+                    pusher = reply_slot
+                    for sp in spec:
+                        self._dispatch_actor_task(sp, pusher)
                 elif spec.kind == ACTOR_TASK:
-                    self._dispatch_actor_task(spec, reply_slot)
+                    self._dispatch_actor_task(spec, None)
                 else:
                     self._execute_task(spec)
             except BaseException:
@@ -239,11 +245,16 @@ class WorkerProc:
         max_concurrency semaphore; threaded actors (max_concurrency>1) use a
         thread pool; default actors execute inline in arrival order
         (reference concurrency_group_manager.h + fiber.h for async actors)."""
-        method = getattr(self.actor_instance, spec.method_name, None) if self.actor_instance else None
-        if method is not None and inspect.iscoroutinefunction(method):
+        ent = self._method_cache.get(spec.method_name)
+        if ent is None and self.actor_instance is not None:
+            m = getattr(self.actor_instance, spec.method_name, None)
+            ent = self._method_cache[spec.method_name] = (
+                m, m is not None and inspect.iscoroutinefunction(m))
+        if ent is not None and ent[1]:
             self._ensure_actor_loop()
             cf = asyncio.run_coroutine_threadsafe(self._a_exec_actor_task(spec), self._actor_loop.loop)
-            cf.add_done_callback(lambda f, rs=reply_slot: self._reply_future(rs, f))
+            cf.add_done_callback(
+                lambda f, rs=reply_slot, tid=spec.task_id: self._reply_future(rs, tid, f))
         elif self.actor_max_concurrency > 1:
             if self._actor_pool is None:
                 from concurrent.futures import ThreadPoolExecutor
@@ -251,10 +262,11 @@ class WorkerProc:
                 self._actor_pool = ThreadPoolExecutor(max_workers=self.actor_max_concurrency,
                                                       thread_name_prefix="rt-actor")
             cf = self._actor_pool.submit(self._execute_actor_task, spec)
-            cf.add_done_callback(lambda f, rs=reply_slot: self._reply_future(rs, f))
+            cf.add_done_callback(
+                lambda f, rs=reply_slot, tid=spec.task_id: self._reply_future(rs, tid, f))
         else:
             reply = self._execute_actor_task(spec)
-            self._reply_value(reply_slot, reply)
+            self._reply_value(reply_slot, spec.task_id, reply)
 
     def _ensure_actor_loop(self):
         if self._actor_loop is None:
@@ -279,15 +291,17 @@ class WorkerProc:
             self._record_event(spec, t0, time.time(), error_blob is None)
             return self._finish_actor_task(spec, value, error_blob)
 
-    def _reply_value(self, reply_slot, reply: dict):
-        reply_slot(reply)  # thread-safe callable (per-conn batched pusher)
+    def _reply_value(self, pusher, task_id: str, reply: dict):
+        if pusher is not None:  # None once the holder's connection closed
+            reply["task_id"] = task_id
+            pusher.add(reply)  # thread-safe per-conn batched pusher
 
-    def _reply_future(self, reply_slot, done_future):
+    def _reply_future(self, pusher, task_id: str, done_future):
         try:
             reply = done_future.result()
         except BaseException as e:  # executor infrastructure failure
             reply = {"results": [], "error": None, "exec_failure": str(e)}
-        self._reply_value(reply_slot, reply)
+        self._reply_value(pusher, task_id, reply)
 
     def _record_event(self, spec: TaskSpec, start: float, end: float,
                       ok: bool):
@@ -428,6 +442,7 @@ class WorkerProc:
                 cls = self.worker.load_function(spec.function_id)
                 args, kwargs = self.worker.decode_args(spec.args, spec.kwargs)
                 self.actor_instance = cls(*args, **kwargs)
+                self._method_cache.clear()
                 self.actor_id = spec.actor_id
                 self.actor_max_concurrency = max(1, spec.max_concurrency)
             else:
@@ -551,9 +566,14 @@ class WorkerProc:
         try:
             if self.actor_instance is None:
                 raise RuntimeError("actor instance not initialized")
-            method = getattr(self.actor_instance, spec.method_name)
-            args, kwargs = self.worker.decode_args(spec.args, spec.kwargs)
-            value = method(*args, **kwargs)
+            ent = self._method_cache.get(spec.method_name)
+            method = ent[0] if ent is not None and ent[0] is not None \
+                else getattr(self.actor_instance, spec.method_name)
+            if spec.args or spec.kwargs:
+                args, kwargs = self.worker.decode_args(spec.args, spec.kwargs)
+                value = method(*args, **kwargs)
+            else:
+                value = method()
         except BaseException as e:  # noqa: BLE001
             error_blob = self._make_error_blob(spec, e)
         self._record_event(spec, t0, time.time(), error_blob is None)
@@ -582,7 +602,16 @@ class WorkerProc:
 def main():
     import signal
 
+    _prof = [None]
+
     def _term(signum, frame):
+        if _prof[0] is not None:
+            try:
+                _prof[0].disable()
+                _prof[0].dump_stats(os.path.join(
+                    os.environ["RT_PROFILE_WORKER"], f"worker_{os.getpid()}.pstats"))
+            except Exception:
+                pass
         rpc.cleanup_sockets()
         os._exit(0)
 
@@ -590,6 +619,21 @@ def main():
     logging.basicConfig(level=logging.INFO, format=f"[worker %(process)d] %(message)s")
     proc = WorkerProc()
     proc.start()
+    profile_dir = os.environ.get("RT_PROFILE_WORKER")
+    if profile_dir:  # dev-only: per-worker cProfile dumps for hot-path work
+        import cProfile
+
+        pr = cProfile.Profile()
+        _prof[0] = pr
+        pr.enable()
+        try:
+            proc.run()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            pr.disable()
+            pr.dump_stats(os.path.join(profile_dir, f"worker_{os.getpid()}.pstats"))
+        return
     try:
         proc.run()
     except KeyboardInterrupt:
