@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import atexit
 import logging
+import os
 import threading
 from typing import Any, Iterable, Sequence
 
@@ -64,6 +65,10 @@ def init(
         # silently disable RT_* env resolution for the rest of the process.
         _config_baseline = dict(CONFIG._overrides)
         CONFIG.apply_system_config(_system_config)
+        if address is None:
+            # Submitted jobs inherit the cluster address from their runner
+            # (reference: RAY_ADDRESS set by the job supervisor).
+            address = os.environ.get("RT_ADDRESS") or None
         if address is None:
             _head = HeadNode(
                 num_cpus=num_cpus,

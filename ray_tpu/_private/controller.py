@@ -113,6 +113,10 @@ class Controller:
         self.pgs: dict[str, dict] = {}
         self.pg_bundles: dict[tuple, dict] = {}  # (pg_id, idx) -> {node, available, reserved}
         self.kv: dict[tuple, bytes] = {}
+        # Job table (reference gcs_job_manager + dashboard job_manager.py:60):
+        # submission_id -> {entrypoint, status, message, node_id, start/end,
+        # metadata, runtime_env}. Driver subprocesses run on a node agent.
+        self.jobs: dict[str, dict] = {}
         # task_id -> (force, expiry), for cancels that land while the task is
         # queued or mid-dispatch (neither pending nor dispatched yet).
         # Entries expire so cancels racing completion (or actor-method refs
@@ -871,6 +875,99 @@ class Controller:
             except asyncio.TimeoutError:
                 return {"status": "timeout"}
 
+    # --------------------------------------------------------------- jobs
+    async def _h_submit_job(self, conn, a):
+        """Run an entrypoint shell command as a driver subprocess on a node
+        agent (reference JobManager.submit_job,
+        dashboard/modules/job/job_manager.py:423)."""
+        sid = a.get("submission_id") or f"raysubmit_{os.urandom(8).hex()}"
+        if sid in self.jobs and self.jobs[sid]["status"] in ("PENDING", "RUNNING"):
+            raise rpc.RpcError(f"job {sid} already exists")
+        nid, nconn = None, None
+        for cand, c in self.node_conns.items():
+            if not c.closed and self.nodes.get(cand) and self.nodes[cand].alive:
+                nid, nconn = cand, c
+                break
+        if nconn is None:
+            raise rpc.RpcError("no alive node to run the job on")
+        self.jobs[sid] = {
+            "submission_id": sid, "entrypoint": a["entrypoint"],
+            "status": "PENDING", "message": "", "node_id": nid,
+            "start_time": time.time(), "end_time": None,
+            "metadata": a.get("metadata") or {},
+            "runtime_env": a.get("runtime_env") or {},
+        }
+        try:
+            rep = await nconn.call(
+                "run_job", submission_id=sid, entrypoint=a["entrypoint"],
+                runtime_env=a.get("runtime_env"))
+        except Exception as e:
+            # The RPC failing must not strand the id in PENDING forever
+            # (non-terminal states block resubmission of the same id).
+            job = self.jobs[sid]
+            job["status"] = "FAILED"
+            job["message"] = f"run_job RPC failed: {e!r}"
+            job["end_time"] = time.time()
+            raise
+        job = self.jobs[sid]
+        if rep.get("status") == "running":
+            job["status"] = "RUNNING"
+        else:
+            job["status"] = "FAILED"
+            job["message"] = rep.get("message", "spawn failed")
+            job["end_time"] = time.time()
+        return {"submission_id": sid, "status": job["status"]}
+
+    async def _p_job_done(self, conn, a):
+        job = self.jobs.get(a["submission_id"])
+        if job is None or job["status"] not in ("PENDING", "RUNNING"):
+            return
+        rc = a.get("returncode")
+        if a.get("stopped"):
+            job["status"] = "STOPPED"
+        elif rc == 0:
+            job["status"] = "SUCCEEDED"
+        else:
+            job["status"] = "FAILED"
+            job["message"] = f"entrypoint exited with code {rc}"
+        job["end_time"] = time.time()
+
+    async def _h_stop_job(self, conn, a):
+        sid = a["submission_id"]
+        job = self.jobs.get(sid)
+        if job is None:
+            raise rpc.RpcError(f"job {sid} not found")
+        if job["status"] not in ("PENDING", "RUNNING"):
+            return {"stopped": False, "status": job["status"]}
+        nconn = self.node_conns.get(job["node_id"])
+        if nconn is None or nconn.closed:
+            job["status"] = "FAILED"
+            job["message"] = "job node died"
+            job["end_time"] = time.time()
+            return {"stopped": False, "status": job["status"]}
+        rep = await nconn.call("stop_job", submission_id=sid)
+        return {"stopped": rep.get("stopped", False), "status": job["status"]}
+
+    async def _h_get_job(self, conn, a):
+        job = self.jobs.get(a["submission_id"])
+        if job is None:
+            raise rpc.RpcError(f"job {a['submission_id']} not found")
+        return {"job": job}
+
+    async def _h_list_jobs(self, conn, a):
+        return {"jobs": list(self.jobs.values())}
+
+    async def _h_job_logs(self, conn, a):
+        sid = a["submission_id"]
+        job = self.jobs.get(sid)
+        if job is None:
+            raise rpc.RpcError(f"job {sid} not found")
+        nconn = self.node_conns.get(job["node_id"])
+        if nconn is None or nconn.closed:
+            return {"data": b"", "offset": int(a.get("offset", 0)), "found": False}
+        return await nconn.call("job_logs", submission_id=sid,
+                                offset=int(a.get("offset", 0)))
+
     # -------------------------------------------------------- observability
     async def _p_task_events(self, conn, a):
         self.task_events.extend(a["events"])
@@ -1319,6 +1416,12 @@ class Controller:
             if info["node_id"] == nid:
                 self.dispatched.pop(task_id, None)
                 await self._retry_or_fail(info["spec"], f"node {nid[:8]} died")
+        # Jobs whose driver ran there can't finish.
+        for job in self.jobs.values():
+            if job["node_id"] == nid and job["status"] in ("PENDING", "RUNNING"):
+                job["status"] = "FAILED"
+                job["message"] = f"node {nid[:8]} hosting the job driver died"
+                job["end_time"] = time.time()
         # Restart/kill its actors.
         for actor_id, ent in list(self.actors.items()):
             if ent.node_id == nid and ent.state in ("ALIVE", "PENDING", "RESTARTING"):
@@ -1382,7 +1485,8 @@ class Controller:
     def _place_bundles(self, bundles: list[ResourceSet], strategy: str):
         """2-phase prepare/commit is unnecessary with a central scheduler —
         placement is atomic here (cf. reference GcsPlacementGroupScheduler)."""
-        avail = {nid: n.available.copy() for nid, n in self.nodes.items() if n.alive}
+        avail = {nid: n.available.copy() for nid, n in self.nodes.items()
+                 if n.alive and not n.draining}
         placed: list[tuple[str, ResourceSet]] = []
         used_nodes: set[str] = set()
         for rs in bundles:
@@ -1486,6 +1590,36 @@ class Controller:
         return {"keys": [k for (n, k) in self.kv if n == ns and k.startswith(prefix)]}
 
     # ------------------------------------------------------------ state API
+    async def _h_drain_node(self, conn, a):
+        """Mark a node unschedulable (autoscaler scale-down handshake;
+        reference DrainNode, gcs_node_manager). Running work is untouched;
+        the caller re-checks idleness before terminating."""
+        node = self.nodes.get(a["node_id"])
+        if node is None:
+            return {"ok": False}
+        node.draining = bool(a.get("on", True))
+        return {"ok": True}
+
+    async def _h_resource_demand(self, conn, a):
+        """Aggregate unmet resource demand (reference autoscaler v2's
+        ClusterStatus demand summary, autoscaler/v2/autoscaler.py:42): the
+        resource shapes of queued tasks/actor creations plus the bundles of
+        placement groups that could not be placed. Drives scale-up."""
+        unit = CONFIG.resource_unit
+        demands: list[dict] = []
+        for spec in self.pending:
+            demands.append({k: v / unit for k, v in (spec.resources or {}).items()})
+        for ent in self.actors.values():
+            if ent.state == "PENDING" and not ent.resources_held:
+                demands.append({k: v / unit
+                                for k, v in (ent.spec.resources or {}).items()})
+        pg_demands: list[dict] = []
+        for pg in self.pgs.values():
+            if pg.get("state") == "PENDING":
+                for raw in pg.get("bundles_raw", []):
+                    pg_demands.append({k: v / unit for k, v in raw.items()})
+        return {"demand": demands, "pg_demand": pg_demands}
+
     async def _h_cluster_resources(self, conn, a):
         total: dict[str, float] = {}
         avail: dict[str, float] = {}

@@ -66,6 +66,7 @@ class NodeAgent:
         self.store = LocalStore(session_id, CONFIG.object_store_memory_bytes, CONFIG.object_spill_dir, CONFIG.shm_dir)
         self.controller: Optional[rpc.Connection] = None
         self.workers: dict[str, _WorkerSlot] = {}
+        self.jobs: dict[str, dict] = {}  # submission_id -> {proc, log_path, stopped}
         self._idle_waiters: deque = None  # set in start
         self._tasks: list[asyncio.Task] = []
         self._stopping = False
@@ -115,7 +116,98 @@ class NodeAgent:
             slot = await self._acquire_pool_worker()
             slot.state = "leased"
             return {"worker_id": slot.worker_id, "address": slot.address}
+        if method == "run_job":
+            return self._run_job(a)
+        if method == "stop_job":
+            return self._stop_job(a["submission_id"])
+        if method == "job_logs":
+            return self._job_logs(a["submission_id"], int(a.get("offset", 0)))
         raise rpc.RpcError(f"agent: unknown ctrl method {method}")
+
+    # ------------------------------------------------------------- jobs
+    # Reference: the job supervisor runs the entrypoint as a shell
+    # subprocess with RAY_ADDRESS injected and streams its output to a
+    # per-job log file (dashboard/modules/job/job_manager.py:60,
+    # job_supervisor's _exec_entrypoint). Same shape here: the agent owns
+    # the driver subprocess; the controller owns the status table.
+    def _run_job(self, a: dict) -> dict:
+        sid = a["submission_id"]
+        env = dict(os.environ)
+        env.update(self.extra_env)
+        import ray_tpu
+
+        pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
+        env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
+        env["RT_ADDRESS"] = f"{self.controller_addr[0]}:{self.controller_addr[1]}"
+        env["RT_JOB_SUBMISSION_ID"] = sid
+        for k, v in ((a.get("runtime_env") or {}).get("env_vars") or {}).items():
+            env[k] = str(v)
+        log_dir = os.path.join(CONFIG.session_dir, self.session_id, "logs")
+        os.makedirs(log_dir, exist_ok=True)
+        log_path = os.path.join(log_dir, f"job-{sid}.log")
+        log_f = open(log_path, "ab")
+        cwd = (a.get("runtime_env") or {}).get("working_dir") or None
+        try:
+            proc = subprocess.Popen(
+                a["entrypoint"], shell=True, env=env, cwd=cwd,
+                stdout=log_f, stderr=subprocess.STDOUT,
+                start_new_session=True)  # own pgid: stop_job kills the tree
+        except Exception as e:
+            return {"status": "failed", "message": f"spawn failed: {e!r}"}
+        finally:
+            log_f.close()  # the child holds its own inherited fd
+        self.jobs[sid] = {"proc": proc, "log_path": log_path, "stopped": False}
+        asyncio.ensure_future(self._watch_job(sid, proc))
+        return {"status": "running", "pid": proc.pid, "log_path": log_path}
+
+    async def _watch_job(self, sid: str, proc: subprocess.Popen):
+        while proc.poll() is None:
+            await asyncio.sleep(0.1)
+        ent = self.jobs.get(sid)
+        stopped = bool(ent and ent["stopped"])
+        try:
+            await self.controller.push(
+                "job_done", submission_id=sid, returncode=proc.returncode,
+                stopped=stopped)
+        except Exception:
+            pass
+
+    def _stop_job(self, sid: str) -> dict:
+        import signal
+
+        ent = self.jobs.get(sid)
+        if ent is None or ent["proc"].poll() is not None:
+            return {"stopped": False}
+        ent["stopped"] = True
+        try:
+            os.killpg(ent["proc"].pid, signal.SIGTERM)
+        except Exception:
+            ent["proc"].terminate()
+
+        async def _escalate(proc=ent["proc"]):
+            for _ in range(30):  # 3s grace, then SIGKILL the group
+                if proc.poll() is not None:
+                    return
+                await asyncio.sleep(0.1)
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except Exception:
+                proc.kill()
+
+        asyncio.ensure_future(_escalate())
+        return {"stopped": True}
+
+    def _job_logs(self, sid: str, offset: int) -> dict:
+        ent = self.jobs.get(sid)
+        if ent is None:
+            return {"data": b"", "offset": offset, "found": False}
+        try:
+            with open(ent["log_path"], "rb") as f:
+                f.seek(offset)
+                data = f.read(1 << 20)
+            return {"data": data, "offset": offset + len(data), "found": True}
+        except OSError:
+            return {"data": b"", "offset": offset, "found": False}
 
     async def _on_ctrl_push(self, conn, method, a):
         if method == "free":

@@ -18,7 +18,8 @@ from ray_tpu._private.task_spec import SchedulingStrategy
 
 
 class NodeState:
-    __slots__ = ("node_id", "address", "total", "available", "alive", "last_beat", "labels")
+    __slots__ = ("node_id", "address", "total", "available", "alive", "last_beat",
+                 "labels", "draining")
 
     def __init__(self, node_id: str, address: tuple, total: ResourceSet, labels: dict | None = None):
         self.node_id = node_id
@@ -28,6 +29,9 @@ class NodeState:
         self.alive = True
         self.last_beat = 0.0
         self.labels = labels or {}
+        # Draining (autoscaler scale-down handshake): schedulable = False.
+        # The node keeps running what it has; nothing new lands on it.
+        self.draining = False
 
     def utilization(self) -> float:
         scores = []
@@ -46,7 +50,7 @@ def pick_node(
     pg_bundles: Optional[dict] = None,
 ) -> Optional[str]:
     """Return node_id to run on, or None if nothing is feasible right now."""
-    alive = {nid: n for nid, n in nodes.items() if n.alive}
+    alive = {nid: n for nid, n in nodes.items() if n.alive and not n.draining}
     if not alive:
         return None
 

@@ -1,0 +1,199 @@
+"""Dashboard: HTTP/JSON view of cluster state.
+
+Parity target: reference python/ray/dashboard/head.py:46 (DashboardHead —
+an aiohttp server aggregating GCS state for the web UI) with the module
+endpoints that matter operationally (dashboard/modules/{node,actor,job,
+state,reporter}): nodes, actors, tasks, objects, jobs, cluster status, and
+a chrome-trace timeline. JSON only — point curl/a browser at it; the
+reference's React frontend is intentionally out of scope.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import time
+from typing import Optional
+
+from ray_tpu._private import rpc
+
+logger = logging.getLogger(__name__)
+
+_INDEX_HTML = """<html><head><title>ray_tpu dashboard</title></head><body>
+<h2>ray_tpu dashboard</h2><ul>
+<li><a href="/api/cluster_status">/api/cluster_status</a></li>
+<li><a href="/api/nodes">/api/nodes</a></li>
+<li><a href="/api/actors">/api/actors</a></li>
+<li><a href="/api/tasks">/api/tasks</a></li>
+<li><a href="/api/objects">/api/objects</a></li>
+<li><a href="/api/jobs">/api/jobs</a></li>
+<li><a href="/api/timeline">/api/timeline</a> (chrome trace; load in Perfetto)</li>
+</ul></body></html>"""
+
+
+class Dashboard:
+    """Serves cluster state as JSON over HTTP. Runs its own event-loop
+    thread and a single controller connection; safe to start from any
+    process that can reach the controller."""
+
+    def __init__(self, address: str, host: str = "127.0.0.1", port: int = 8265):
+        chost, cport = address.rsplit(":", 1)
+        self._ctrl_addr = (chost, int(cport))
+        self.host, self.port = host, port
+        self._io = rpc.EventLoopThread(name="dashboard")
+        self._conn: Optional[rpc.Connection] = None
+        self._conn_lock: Optional[asyncio.Lock] = None
+        self._runner = None
+
+    async def _a_call(self, method: str, **kw):
+        if self._conn_lock is None:
+            self._conn_lock = asyncio.Lock()
+        async with self._conn_lock:  # concurrent handlers must share one conn
+            if self._conn is None or self._conn.closed:
+                self._conn = await rpc.connect(*self._ctrl_addr)
+                await self._conn.call("register", kind="client",
+                                      worker_id=f"dashboard-{os.getpid()}",
+                                      address=None)
+            conn = self._conn
+        return await conn.call(method, **kw)
+
+    # ------------------------------------------------------------ server
+    def start(self) -> int:
+        """Bind and serve; returns the bound port."""
+
+        async def _up():
+            from aiohttp import web
+
+            app = web.Application()
+            app.router.add_get("/", self._index)
+            app.router.add_get("/api/version", self._version)
+            app.router.add_get("/api/cluster_status", self._cluster_status)
+            app.router.add_get("/api/nodes", self._nodes)
+            app.router.add_get("/api/actors", self._actors)
+            app.router.add_get("/api/tasks", self._tasks)
+            app.router.add_get("/api/objects", self._objects)
+            app.router.add_get("/api/jobs", self._jobs)
+            app.router.add_get("/api/timeline", self._timeline)
+            runner = web.AppRunner(app, access_log=None)
+            await runner.setup()
+            site = web.TCPSite(runner, self.host, self.port)
+            await site.start()
+            self._runner = runner
+            for s in site._server.sockets:  # resolve port=0
+                self.port = s.getsockname()[1]
+            return self.port
+
+        return self._io.run(_up(), timeout=30)
+
+    def stop(self):
+        if self._runner is not None:
+            async def _down():
+                await self._runner.cleanup()
+                if self._conn is not None:
+                    await self._conn.close()
+
+            try:
+                self._io.run(_down(), timeout=10)
+            except Exception:
+                pass
+        self._io.stop()
+
+    # ---------------------------------------------------------- handlers
+    async def _index(self, request):
+        from aiohttp import web
+
+        return web.Response(text=_INDEX_HTML, content_type="text/html")
+
+    async def _version(self, request):
+        from aiohttp import web
+
+        import ray_tpu
+
+        return web.json_response({"ray_tpu": getattr(ray_tpu, "__version__", "dev"),
+                                  "time": time.time()})
+
+    async def _cluster_status(self, request):
+        from aiohttp import web
+
+        res = await self._a_call("cluster_resources")
+        dem = await self._a_call("resource_demand")
+        return web.json_response({
+            "total": res["total"], "available": res["available"],
+            "demand": dem["demand"], "pg_demand": dem["pg_demand"],
+        })
+
+    async def _nodes(self, request):
+        from aiohttp import web
+
+        snap = await self._a_call("state_snapshot")
+        return web.json_response({"nodes": [
+            {"node_id": nid, **info} for nid, info in snap["nodes"].items()]})
+
+    async def _actors(self, request):
+        from aiohttp import web
+
+        snap = await self._a_call("state_snapshot")
+        return web.json_response({"actors": [
+            {"actor_id": aid, **info} for aid, info in snap["actors"].items()]})
+
+    async def _tasks(self, request):
+        from aiohttp import web
+
+        limit = int(request.query.get("limit", 1000))
+        rep = await self._a_call("list_tasks", limit=limit)
+        return web.json_response({"tasks": rep["tasks"]})
+
+    async def _objects(self, request):
+        from aiohttp import web
+
+        limit = int(request.query.get("limit", 1000))
+        rep = await self._a_call("list_objects", limit=limit)
+        return web.json_response({"objects": rep["objects"]})
+
+    async def _jobs(self, request):
+        from aiohttp import web
+
+        rep = await self._a_call("list_jobs")
+        return web.json_response({"jobs": rep["jobs"]})
+
+    async def _timeline(self, request):
+        from aiohttp import web
+
+        rep = await self._a_call("get_task_events")
+        # Same chrome-trace shaping as ray_tpu.timeline() (reference
+        # _private/state.py:965), rendered server-side for curl users.
+        events = rep["events"]
+        node_pid: dict[str, int] = {}
+        trace: list[dict] = []
+        for ev in events:
+            pid = node_pid.setdefault(ev["node_id"], len(node_pid) + 1)
+            trace.append({
+                "ph": "X", "name": ev["name"], "cat": ev["kind"],
+                "pid": pid, "tid": int(ev["pid"]),
+                "ts": ev["start"] * 1e6,
+                "dur": max(1.0, (ev["end"] - ev["start"]) * 1e6),
+                "args": {"task_id": ev["task_id"], "ok": ev["ok"],
+                         "attempt": ev["attempt"]},
+            })
+        return web.json_response(trace)
+
+
+def start_dashboard(address: Optional[str] = None, host: str = "127.0.0.1",
+                    port: int = 8265) -> Dashboard:
+    """Start a dashboard against `address` (or the current driver's
+    cluster). Returns the running Dashboard (stop() when done)."""
+    if address is None:
+        address = os.environ.get("RT_ADDRESS")
+    if address is None:
+        from ray_tpu._private.worker import global_worker
+
+        w = global_worker()
+        if w is not None:
+            address = f"{w.controller_addr[0]}:{w.controller_addr[1]}"
+    if address is None:
+        raise ValueError("no address: pass one, set RT_ADDRESS, or init() first")
+    d = Dashboard(address, host, port)
+    d.start()
+    return d

@@ -245,6 +245,75 @@ def cmd_status(args) -> int:
     return 0
 
 
+def _resolve_address(args) -> str:
+    if getattr(args, "address", None):
+        return args.address
+    env = os.environ.get("RT_ADDRESS")
+    if env:
+        return env
+    head_file = os.path.join(args.session_dir, "head.json")
+    if os.path.exists(head_file):
+        return json.load(open(head_file))["address"]
+    raise SystemExit("no head recorded; pass --address or set RT_ADDRESS")
+
+
+def cmd_job(args) -> int:
+    """`ray-tpu job submit|status|logs|stop|list` (reference `ray job ...`,
+    dashboard/modules/job/cli.py)."""
+    from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+    client = JobSubmissionClient(_resolve_address(args))
+    try:
+        if args.job_cmd == "submit":
+            import shlex
+
+            ep = args.entrypoint
+            if ep and ep[0] == "--":
+                ep = ep[1:]
+            # Re-quote: the entrypoint runs under `sh -c` on the job node.
+            sid = client.submit_job(entrypoint=shlex.join(ep),
+                                    submission_id=args.submission_id)
+            print(f"submitted: {sid}")
+            if args.no_wait:
+                return 0
+            for chunk in client.tail_job_logs(sid):
+                print(chunk, end="")
+            status = client.get_job_status(sid)
+            print(f"job {sid}: {status}")
+            return 0 if status == JobStatus.SUCCEEDED else 1
+        if args.job_cmd == "status":
+            print(client.get_job_status(args.submission_id))
+            return 0
+        if args.job_cmd == "logs":
+            print(client.get_job_logs(args.submission_id), end="")
+            return 0
+        if args.job_cmd == "stop":
+            stopped = client.stop_job(args.submission_id)
+            print("stopped" if stopped else "not running")
+            return 0
+        if args.job_cmd == "list":
+            for j in client.list_jobs():
+                print(f"{j['submission_id']}  {j['status']:<9}  {j['entrypoint']}")
+            return 0
+        raise SystemExit(f"unknown job command {args.job_cmd}")
+    finally:
+        client.close()
+
+
+def cmd_dashboard(args) -> int:
+    from ray_tpu.dashboard import Dashboard
+
+    d = Dashboard(_resolve_address(args), host=args.host, port=args.port)
+    port = d.start()
+    print(f"dashboard at http://{args.host}:{port}")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        d.stop()
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(prog="ray-tpu")
     p.add_argument("--session-dir", default=_default_session_dir())
@@ -266,6 +335,26 @@ def main(argv=None) -> int:
     pt = sub.add_parser("status", help="print cluster state")
     pt.add_argument("--address", default=None)
     pt.set_defaults(fn=cmd_status)
+
+    pj = sub.add_parser("job", help="submit and manage jobs")
+    pj.add_argument("--address", default=None)
+    jsub = pj.add_subparsers(dest="job_cmd", required=True)
+    js = jsub.add_parser("submit")
+    js.add_argument("--submission-id", default=None)
+    js.add_argument("--no-wait", action="store_true")
+    js.add_argument("entrypoint", nargs=argparse.REMAINDER,
+                    help="shell command, e.g. -- python train.py")
+    for name in ("status", "logs", "stop"):
+        jp = jsub.add_parser(name)
+        jp.add_argument("submission_id")
+    jsub.add_parser("list")
+    pj.set_defaults(fn=cmd_job)
+
+    pd = sub.add_parser("dashboard", help="serve the HTTP dashboard")
+    pd.add_argument("--address", default=None)
+    pd.add_argument("--host", default="127.0.0.1")
+    pd.add_argument("--port", type=int, default=8265)
+    pd.set_defaults(fn=cmd_dashboard)
 
     args = p.parse_args(argv)
     return args.fn(args)

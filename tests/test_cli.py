@@ -52,3 +52,28 @@ def test_cli_cluster_lifecycle(tmp_path, shutdown_only):
         r = _cli(sdir, "stop")
     assert "stopped" in r.stdout
     assert not (sdir / "head.json").exists()
+
+
+def test_cli_job_workflow(tmp_path):
+    """ray-tpu job submit/status/logs/list against a CLI-started head
+    (reference dashboard/modules/job/tests + `ray job submit`)."""
+    sdir = tmp_path / "session"
+    try:
+        r = _cli(sdir, "start", "--head", "--num-cpus", "1", "--port", "0")
+        assert r.returncode == 0, r.stderr
+
+        r = _cli(sdir, "job", "submit", "--submission-id", "jobA", "--",
+                 "python", "-c", "print(6 * 7)")
+        assert r.returncode == 0, r.stderr + r.stdout
+        assert "42" in r.stdout and "SUCCEEDED" in r.stdout, r.stdout
+
+        r = _cli(sdir, "job", "status", "jobA")
+        assert r.stdout.strip() == "SUCCEEDED", r.stdout
+
+        r = _cli(sdir, "job", "logs", "jobA")
+        assert "42" in r.stdout
+
+        r = _cli(sdir, "job", "list")
+        assert "jobA" in r.stdout and "SUCCEEDED" in r.stdout
+    finally:
+        _cli(sdir, "stop")
