@@ -571,7 +571,8 @@ class Controller:
         await self._retry_or_fail(spec, a.get("reason", "worker died"))
         self._kick()
 
-    async def _retry_or_fail(self, spec: TaskSpec, reason: str, final_error=None):
+    async def _retry_or_fail(self, spec: TaskSpec, reason: str, final_error=None,
+                             error_type: str | None = None):
         if spec.kind == ACTOR_CREATE:
             await self._maybe_restart_actor(spec.actor_id, reason)
             return
@@ -585,7 +586,8 @@ class Controller:
         if final_error is None:
             from ray_tpu._private.serialization import dumps_oob
 
-            err_header, err_bufs = dumps_oob({"type": "WorkerCrashedError", "message": reason})
+            err_header, err_bufs = dumps_oob(
+                {"type": error_type or "WorkerCrashedError", "message": reason})
             final_error = [err_header, *err_bufs]
         for oid in spec.return_object_ids():
             if self._freed(oid):
@@ -785,14 +787,15 @@ class Controller:
                 except Exception:
                     pass
 
-    async def _lease_worker_died(self, worker_id: str):
+    async def _lease_worker_died(self, worker_id: str, cause: str | None = None):
         for lease_id, ent in list(self.leases.items()):
             if ent["worker_id"] == worker_id:
                 self._drop_lease(lease_id)
                 oconn = self.client_conns.get(ent["owner"])
                 if oconn is not None and not oconn.closed:
                     try:
-                        await oconn.push("lease_invalid", lease_id=lease_id)
+                        await oconn.push("lease_invalid", lease_id=lease_id,
+                                         cause=cause)
                     except Exception:
                         pass
 
@@ -1375,9 +1378,11 @@ class Controller:
         await self._maybe_restart_actor(actor_id, reason)
 
     async def _p_worker_died(self, conn, a):
-        """Node agent reports a worker process exit."""
+        """Node agent reports a worker process exit. `cause="oom"` marks a
+        memory-monitor kill so owners surface OutOfMemoryError."""
+        cause = a.get("cause")
         if a.get("worker_id"):
-            await self._lease_worker_died(a["worker_id"])
+            await self._lease_worker_died(a["worker_id"], cause=cause)
         actor_id = a.get("actor_id")
         task_id = a.get("task_id")
         if actor_id:
@@ -1390,7 +1395,9 @@ class Controller:
                 spec = info["spec"]
                 if spec.kind != ACTOR_CREATE:
                     self._release(info["node_id"], spec, ResourceSet(_raw=spec.resources))
-                await self._retry_or_fail(spec, "worker process died")
+                await self._retry_or_fail(
+                    spec, a.get("reason") or "worker process died",
+                    error_type="OutOfMemoryError" if cause == "oom" else None)
                 self._kick()
 
     # ------------------------------------------------------- node failure

@@ -46,7 +46,8 @@ def _class_key(spec: TaskSpec) -> tuple:
 
 class _Lease:
     __slots__ = ("lease_id", "worker_id", "node_id", "addr", "conn", "inflight",
-                 "buf", "flushing", "dead", "idle_since", "cls", "kill_target")
+                 "buf", "flushing", "dead", "idle_since", "cls", "kill_target",
+                 "fail_cause")
 
     def __init__(self, cls, lease_id: str, worker_id: str, node_id: str, addr: tuple):
         self.cls = cls
@@ -60,6 +61,7 @@ class _Lease:
         self.flushing = False
         self.dead = False
         self.idle_since = time.monotonic()
+        self.fail_cause: Optional[str] = None  # e.g. "oom" from the monitor
         # task_id being force-cancelled via worker kill; while set, the lease
         # takes no new work and _lease_failed requeues innocent bystanders
         # without burning an attempt.
@@ -324,6 +326,11 @@ class LeaseManager:
             elif spec.attempt < spec.max_retries:
                 spec.attempt += 1
                 requeue.append(spec)
+            elif lease.fail_cause == "oom":
+                self._fail_spec(spec, {
+                    "type": "OutOfMemoryError",
+                    "message": f"leased worker {lease.worker_id[:8]} was "
+                               f"killed by the node memory monitor"})
             else:
                 self._fail_spec(spec, {
                     "type": "WorkerCrashedError",
@@ -338,9 +345,10 @@ class LeaseManager:
         if lease.cls.queue:
             self._pump(lease.cls)
 
-    def on_lease_invalid(self, lease_id: str):
+    def on_lease_invalid(self, lease_id: str, cause: str | None = None):
         lease = self._by_id.get(lease_id)
         if lease is not None:
+            lease.fail_cause = cause
             self._lease_failed(lease, release=False)
 
     # -------------------------------------------------------- cancellation

@@ -15,6 +15,7 @@ import logging
 import os
 import subprocess
 import sys
+import time
 from collections import deque
 from typing import Optional
 
@@ -29,7 +30,7 @@ logger = logging.getLogger(__name__)
 
 class _WorkerSlot:
     __slots__ = ("worker_id", "proc", "conn", "state", "task_id", "actor_id", "address",
-                 "registered", "dedicated", "idle_since")
+                 "registered", "dedicated", "idle_since", "assigned_at")
 
     def __init__(self, worker_id: str, proc, dedicated: bool = False):
         self.worker_id = worker_id
@@ -42,6 +43,7 @@ class _WorkerSlot:
         self.registered = asyncio.Event()
         self.dedicated = dedicated  # spawned for an actor; never joins the pool
         self.idle_since: float = 0.0
+        self.assigned_at: float = 0.0  # last task/lease/actor assignment time
 
 
 class NodeAgent:
@@ -93,6 +95,8 @@ class NodeAgent:
         self.logs_enabled = bool(rep.get("log_sub", False))
         self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._tasks.append(asyncio.ensure_future(self._reap_loop()))
+        if CONFIG.memory_monitor_refresh_ms > 0:
+            self._tasks.append(asyncio.ensure_future(self._memory_monitor_loop()))
         if CONFIG.prestart_workers and self.resources_raw.get("CPU", 0) > 0:
             self._spawn_worker()  # hide first-task process startup latency
         return self.port
@@ -115,6 +119,7 @@ class NodeAgent:
         if method == "lease_worker":
             slot = await self._acquire_pool_worker()
             slot.state = "leased"
+            slot.assigned_at = time.monotonic()
             return {"worker_id": slot.worker_id, "address": slot.address}
         if method == "run_job":
             return self._run_job(a)
@@ -264,7 +269,11 @@ class NodeAgent:
             mv = self.store.get(a["oid"])
             if mv is None:
                 return {"found": False}
-            return {"found": True, "data": mv}
+            off = a.get("offset")
+            if off is None:
+                return {"found": True, "data": mv, "size": len(mv)}
+            return {"found": True, "size": len(mv),
+                    "data": mv[off : off + a["length"]]}
         raise rpc.RpcError(f"agent: unknown method {method}")
 
     async def _on_push(self, conn, method, a):
@@ -286,6 +295,7 @@ class NodeAgent:
     async def _dispatch(self, spec: TaskSpec) -> dict:
         slot = await self._acquire_worker(spec)
         slot.task_id = spec.task_id
+        slot.assigned_at = time.monotonic()
         if spec.kind == ACTOR_CREATE:
             slot.state = "actor"
             slot.actor_id = spec.actor_id
@@ -460,8 +470,6 @@ class NodeAgent:
         disconnect + waitpid; we poll) and reap long-idle pool workers
         (reference worker_pool.cc TryKillingIdleWorkers,
         idle_worker_killing_time_threshold_ms), keeping one warm."""
-        import time
-
         while True:
             await asyncio.sleep(0.2)
             for wid, slot in list(self.workers.items()):
@@ -476,7 +484,8 @@ class NodeAgent:
                     if now - slot.idle_since > keep:
                         self._kill_slot(slot)
 
-    async def _worker_exited(self, slot: _WorkerSlot, reason: str):
+    async def _worker_exited(self, slot: _WorkerSlot, reason: str,
+                             cause: str | None = None):
         if slot.state == "dead":
             self.workers.pop(slot.worker_id, None)
             return
@@ -491,9 +500,71 @@ class NodeAgent:
                     task_id=slot.task_id if prev_state == "busy" else None,
                     actor_id=slot.actor_id,
                     reason=reason,
+                    cause=cause,
                 )
             except Exception:
                 pass
+
+    # ------------------------------------------------------- OOM defense
+    # Reference: memory_monitor.h (threshold poll over cgroup/meminfo) +
+    # worker_killing_policy.h (prefer retriable, newest first). The agent
+    # reports the kill BEFORE terminating the process so owners can surface
+    # OutOfMemoryError instead of a generic crash.
+    @staticmethod
+    def _memory_usage_fraction() -> float:
+        try:  # cgroup v2 (containers): respect the limit we actually have
+            with open("/sys/fs/cgroup/memory.max") as f:
+                lim = f.read().strip()
+            if lim != "max":
+                with open("/sys/fs/cgroup/memory.current") as f:
+                    cur = int(f.read().strip())
+                return cur / max(1, int(lim))
+        except OSError:
+            pass
+        try:
+            total = avail = None
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    if line.startswith("MemTotal:"):
+                        total = int(line.split()[1])
+                    elif line.startswith("MemAvailable:"):
+                        avail = int(line.split()[1])
+                    if total is not None and avail is not None:
+                        return 1.0 - avail / max(1, total)
+        except OSError:
+            pass
+        return 0.0
+
+    def _pick_oom_victim(self) -> "_WorkerSlot | None":
+        """Newest-first, retriable-first: pool task workers (tasks retry by
+        default), then leased workers, then actors (restarts are opt-in)."""
+        for states in (("busy",), ("leased",), ("actor",)):
+            cands = [s for s in self.workers.values()
+                     if s.state in states and s.proc.poll() is None]
+            if cands:
+                return max(cands, key=lambda s: s.assigned_at)
+        return None
+
+    async def _memory_monitor_loop(self):
+        period = max(0.05, CONFIG.memory_monitor_refresh_ms / 1000.0)
+        while True:
+            await asyncio.sleep(period)
+            threshold = CONFIG.memory_usage_threshold
+            if threshold >= 1.0:
+                continue
+            frac = self._memory_usage_fraction()
+            if frac < threshold:
+                continue
+            victim = self._pick_oom_victim()
+            if victim is None:
+                continue
+            reason = (f"killed by the memory monitor: node memory usage "
+                      f"{frac:.1%} exceeds threshold {threshold:.1%}")
+            logger.warning("OOM defense: worker %s %s",
+                           victim.worker_id[:8], reason)
+            await self._worker_exited(victim, reason, cause="oom")
+            self._kill_slot(victim)
+            await asyncio.sleep(period)  # let the kill take effect
 
 
 async def run_agent_until_cancelled(agent: NodeAgent):

@@ -22,6 +22,38 @@ import threading
 import time
 
 
+class _StreamWriter:
+    """Chunk sink for LocalStore.begin_stream (remote object fetch)."""
+
+    __slots__ = ("_store", "oid", "_tmp", "_mm", "total", "_cap", "_done")
+
+    def __init__(self, store: "LocalStore", oid: str, tmp: str, mm, total: int,
+                 cap: int):
+        self._store = store
+        self.oid = oid
+        self._tmp = tmp
+        self._mm = mm
+        self.total = total
+        self._cap = cap
+        self._done = False
+
+    def write(self, offset: int, data) -> None:
+        if not isinstance(data, (bytes, bytearray)):
+            data = memoryview(data).cast("B")
+        self._mm[offset : offset + len(data)] = data
+
+    def seal(self) -> bool:
+        self._done = True
+        return self._store._finish_stream(self.oid, self._tmp, self._mm,
+                                          self.total, self._cap)
+
+    def abort(self) -> None:
+        if self._done:
+            return
+        self._done = True
+        self._store._abort_stream(self._tmp, self._mm, self.total)
+
+
 class LocalStore:
     def __init__(self, session_id: str, capacity_bytes: int, spill_dir: str, shm_dir: str = "/dev/shm"):
         self.session = session_id[:8]
@@ -152,6 +184,79 @@ class LocalStore:
             }
             self._used += total
             return total
+
+    def begin_stream(self, oid: str, total: int):
+        """Start writing an object of known size that arrives in chunks
+        (remote fetch): bytes land in a uniquely-named temp segment that is
+        renamed into place at seal, so same-host attachers can never observe
+        a half-written object. Returns None if the oid is already local."""
+        with self._lock:
+            if oid in self._objects:
+                return None
+            self._maybe_evict(total)
+            # Reserve NOW: concurrent streams/puts must see these bytes as
+            # committed or they over-commit the store during the transfer.
+            self._used += total
+            self._spare_seq += 1
+            seq = self._spare_seq
+        tmp = os.path.join(self.shm_dir,
+                           f"rt_{self.session}_in{os.getpid()}_{seq}")
+        cap = max(total, 1)
+        try:
+            fd = os.open(tmp, os.O_CREAT | os.O_RDWR | os.O_TRUNC, 0o600)
+            try:
+                os.ftruncate(fd, cap)
+                mm = mmap.mmap(fd, cap)
+            finally:
+                os.close(fd)
+        except OSError:
+            with self._lock:
+                self._used -= total
+            raise
+        return _StreamWriter(self, oid, tmp, mm, total, cap)
+
+    def _finish_stream(self, oid: str, tmp: str, mm, total: int, cap: int) -> bool:
+        """Seal a streamed segment (commits the reservation taken by
+        begin_stream). Returns False if another copy won the race or the
+        rename failed; the temp and the reservation are dropped."""
+        def _drop():
+            self._used -= total
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            try:
+                mm.close()
+            except (BufferError, ValueError):
+                pass
+
+        with self._lock:
+            if oid in self._objects:
+                _drop()
+                return False
+            try:
+                os.rename(tmp, self._path(oid))
+            except OSError:
+                _drop()
+                return False
+            self._objects[oid] = {
+                "size": total, "cap": cap, "where": "shm",
+                "last_used": time.monotonic(), "mm": mm,
+                "mv": memoryview(mm)[:total], "created": True, "pin": None,
+            }
+            return True
+
+    def _abort_stream(self, tmp: str, mm, total: int) -> None:
+        with self._lock:
+            self._used -= total
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        try:
+            mm.close()
+        except (BufferError, ValueError):
+            pass
 
     def detach(self, oid: str) -> None:
         """Drop our mapping but leave the file for other readers (used by

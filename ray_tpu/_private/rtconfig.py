@@ -49,6 +49,16 @@ _flag("lineage_reconstruction_enabled", bool, True)
 # between the owner shipping a ref inside a payload and the receiving process
 # materializing it; cf. reference reference_count.h borrower handshake).
 _flag("borrowed_free_grace_s", float, 60.0)
+# OOM defense (reference memory_monitor.h + worker_killing_policy.h): when
+# node memory usage crosses the threshold, the agent kills the newest
+# retriable worker. refresh_ms <= 0 disables the monitor.
+_flag("memory_usage_threshold", float, 0.95)
+_flag("memory_monitor_refresh_ms", int, 250)
+# Object transfer: chunk size for remote fetches and the cap on bytes in
+# flight across concurrent pulls (reference object_manager chunked transfer
+# + pull_manager admission control).
+_flag("object_chunk_bytes", int, 16 * 1024 * 1024)
+_flag("pull_max_inflight_bytes", int, 512 * 1024 * 1024)
 _flag("max_pending_calls_default", int, -1)
 _flag("log_to_driver", bool, True)
 # Fixed-point resource arithmetic granularity (reference fixed_point.h uses 1e-4).

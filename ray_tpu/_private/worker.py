@@ -245,6 +245,9 @@ class Worker:
         # The controller learns only the 0<->1 transitions.
         self._borrows: dict[str, int] = {}
         self._borrows_lock = threading.Lock()
+        # Pull admission control (reference pull_manager.h:49).
+        self._pull_cv = threading.Condition()
+        self._pull_inflight = 0
         self._escaped: set[str] = set()  # owned oids advertised on escape
         # Oids whose resolution came FROM the controller (queued-path
         # object_ready / object_lost): the controller holds directory state
@@ -339,12 +342,20 @@ class Worker:
     async def _on_request(self, conn, method, a):
         if method == "fetch_object":
             mv = self.store.get(a["oid"])
-            if mv is not None:
-                return {"found": True, "data": mv}
-            parts = self._inline_cache.get(a["oid"])
-            if parts is not None:
-                return {"found": True, "data": b"".join(bytes(p) for p in parts)}
-            return {"found": False}
+            if mv is None:
+                parts = self._inline_cache.get(a["oid"])
+                if parts is None:
+                    return {"found": False}
+                mv = memoryview(parts[0]) if len(parts) == 1 else \
+                    memoryview(b"".join(bytes(p) for p in parts))
+            off = a.get("offset")
+            if off is None:
+                return {"found": True, "data": mv, "size": len(mv)}
+            # Chunked read (reference object transfer is chunked,
+            # object_manager.h Push/Pull): a zero-copy slice of the shm view
+            # rides the wire; the fetcher reassembles into its own segment.
+            return {"found": True, "size": len(mv),
+                    "data": mv[off : off + a["length"]]}
         if method == "health":
             return {"ok": True}
         if method == "whoami":
@@ -381,7 +392,7 @@ class Worker:
 
     async def _on_ctrl_push(self, conn, method, a):
         if method == "lease_invalid":
-            self.lease_mgr.on_lease_invalid(a["lease_id"])
+            self.lease_mgr.on_lease_invalid(a["lease_id"], cause=a.get("cause"))
         elif method == "need_resources":
             self.lease_mgr.on_need_resources()
         elif method == "object_ready":
@@ -628,20 +639,28 @@ class Worker:
         val, found = self._try_local(oid)
         if found:
             return val
-        # remote fetch
+        # Remote fetch. Holders are shuffled so a hot object's readers fan
+        # out across every node that already fetched a copy instead of all
+        # hammering the producer — with add_location below this forms the
+        # broadcast spread (reference push_manager's chunked broadcast).
         last_err = None
+        holders = list(holders)
+        if len(holders) > 1:
+            import random
+
+            random.shuffle(holders)
         for holder in holders:
             if tuple(holder) == tuple(self.server_addr):
                 continue
             try:
-                data = self._fetch_from(tuple(holder), oid, deadline)
-                if data is not None:
-                    self.store.put(oid, [data])
+                ok = self._fetch_from(tuple(holder), oid, deadline)
+                if ok:
                     self.io.spawn(self.controller.push(
                         "add_location", oid=oid,
                         holder=self.agent_addr or self.server_addr))
                     mv = self.store.get(oid)
-                    return self._deserialize_blob(mv)
+                    if mv is not None:
+                        return self._deserialize_blob(mv)
             except Exception as e:  # holder gone; try next
                 last_err = e
         # all holders failed -> try lineage reconstruction
@@ -650,19 +669,73 @@ class Worker:
         raise exc.ObjectLostError(
             f"object {oid[:16]} unavailable (holders {holders}): {last_err}")
 
-    def _fetch_from(self, holder: tuple, oid: str, deadline):
-        async def _f():
-            conn = await rpc.connect(*holder, timeout=5)
-            try:
-                rep = await conn.call("fetch_object", oid=oid)
-            finally:
-                await conn.close()
-            return rep
+    def _acquire_pull(self, nbytes: int):
+        """Admission control (reference pull_manager.h:49): bound the bytes
+        in flight across concurrent fetches. A single fetch is always
+        admitted even when larger than the budget (no starvation)."""
+        cap = CONFIG.pull_max_inflight_bytes
+        with self._pull_cv:
+            while self._pull_inflight > 0 and self._pull_inflight + nbytes > cap:
+                self._pull_cv.wait(timeout=1.0)
+            self._pull_inflight += nbytes
 
-        rep = self.io.run(_f(), timeout=self._remaining(deadline))
-        if rep.get("found"):
-            return rep["data"]
-        return None
+    def _release_pull(self, nbytes: int):
+        with self._pull_cv:
+            self._pull_inflight -= nbytes
+            self._pull_cv.notify_all()
+
+    def _fetch_from(self, holder: tuple, oid: str, deadline) -> bool:
+        """Fetch an object into the local store in bounded chunks. Returns
+        True once a local copy exists (including 'someone else fetched it
+        first')."""
+        chunk = CONFIG.object_chunk_bytes
+
+        async def _fetch_chunk(conn, off):
+            return await conn.call("fetch_object", oid=oid, offset=off,
+                                   length=chunk)
+
+        def _run(coro):
+            return self.io.run(coro, timeout=self._remaining(deadline))
+
+        conn = _run(rpc.connect(*holder, timeout=5))
+        stream = None
+        self._acquire_pull(chunk)
+        held = chunk
+        try:
+            rep = _run(_fetch_chunk(conn, 0))
+            if not rep.get("found"):
+                return False
+            size = rep["size"]
+            first = rep["data"]
+            if size <= len(first):
+                self.store.put(oid, [first])
+                return True
+            stream = self.store.begin_stream(oid, size)
+            if stream is None:
+                return True  # raced: a local copy already exists
+            stream.write(0, first)
+            off = len(first)
+            del rep, first  # release the buffer before the next admission
+            while off < size:
+                rep = _run(_fetch_chunk(conn, off))
+                if not rep.get("found"):
+                    return False  # holder dropped it mid-stream
+                data = rep["data"]
+                stream.write(off, data)
+                off += len(data)
+                del rep, data
+            sealed = stream.seal()
+            stream = None
+            # seal() returning False means a concurrent fetch won the race
+            # (a local copy exists) or the rename failed; either way the
+            # store lookup below decides, so only claim success when the
+            # object is actually there.
+            return sealed or self.store.contains(oid)
+        finally:
+            self._release_pull(held)
+            if stream is not None:
+                stream.abort()
+            self.io.spawn(conn.close())
 
     def _maybe_reconstruct(self, oid: str) -> bool:
         """Lineage reconstruction: resubmit the producing task (reference
@@ -727,6 +800,8 @@ class Worker:
             return err
         if etype == "WorkerCrashedError":
             return exc.WorkerCrashedError(blob.get("message", ""))
+        if etype == "OutOfMemoryError":
+            return exc.OutOfMemoryError(blob.get("message", ""))
         if etype == "ActorDiedError":
             return exc.ActorDiedError(blob.get("message", ""))
         if etype == "TaskCancelledError":

@@ -28,6 +28,12 @@ class TaskError(RayTpuError):
         )
 
 
+class OutOfMemoryError(RayTpuError):
+    """The node memory monitor killed a worker to relieve memory pressure
+    (reference ray.exceptions.OutOfMemoryError, memory_monitor.h +
+    worker_killing_policy.h)."""
+
+
 class WorkerCrashedError(RayTpuError):
     """The worker process executing the task died unexpectedly."""
 

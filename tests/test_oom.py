@@ -1,0 +1,73 @@
+"""OOM defense: memory monitor + worker killing policy.
+
+Parity target: reference python/ray/tests/test_memory_pressure.py — a task
+that pushes node memory past the threshold is killed by the monitor and the
+owner sees OutOfMemoryError (memory_monitor.h, worker_killing_policy.h).
+"""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions
+
+
+def _used_fraction() -> float:
+    total = avail = None
+    with open("/proc/meminfo") as f:
+        for line in f:
+            if line.startswith("MemTotal:"):
+                total = int(line.split()[1])
+            elif line.startswith("MemAvailable:"):
+                avail = int(line.split()[1])
+    return 1.0 - avail / total
+
+
+def test_oom_killed_task_raises_oom_error(shutdown_only):
+    base = _used_fraction()
+    if base > 0.85:
+        pytest.skip("host already under memory pressure")
+    # Threshold sits just above current usage; the hog task crosses it.
+    ray_tpu.init(num_cpus=2, _system_config={
+        "memory_usage_threshold": min(0.95, base + 0.02),
+        "memory_monitor_refresh_ms": 100,
+    })
+
+    @ray_tpu.remote(max_retries=0)
+    def hog():
+        import numpy as np
+
+        # ~6 GiB touched (ones, not zeros: lazily-mapped zero pages would
+        # never become resident and never move MemAvailable).
+        data = np.ones(6 * 1024**3, dtype=np.uint8)
+        import time
+
+        time.sleep(60)
+        return int(data[0])
+
+    with pytest.raises(exceptions.OutOfMemoryError):
+        ray_tpu.get(hog.remote(), timeout=120)
+
+
+def test_oom_retriable_task_retries_then_fails(shutdown_only):
+    base = _used_fraction()
+    if base > 0.85:
+        pytest.skip("host already under memory pressure")
+    ray_tpu.init(num_cpus=2, _system_config={
+        "memory_usage_threshold": min(0.95, base + 0.02),
+        "memory_monitor_refresh_ms": 100,
+    })
+
+    @ray_tpu.remote(max_retries=1)
+    def hog():
+        import numpy as np
+
+        data = np.ones(6 * 1024**3, dtype=np.uint8)
+        import time
+
+        time.sleep(60)
+        return int(data[0])
+
+    # Both the first attempt and the retry get OOM-killed; the final error
+    # is still OutOfMemoryError (retry accounting must survive the kill).
+    with pytest.raises(exceptions.OutOfMemoryError):
+        ray_tpu.get(hog.remote(), timeout=240)
