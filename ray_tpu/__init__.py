@@ -16,7 +16,7 @@ from ray_tpu import exceptions  # noqa: F401
 from ray_tpu._private.bootstrap import HeadNode
 from ray_tpu._private.rtconfig import CONFIG
 from ray_tpu._private.worker import ObjectRef, Worker, global_worker, set_global_worker
-from ray_tpu.actor import ActorClass, ActorHandle, get_actor, kill  # noqa: F401
+from ray_tpu.actor import ActorClass, ActorHandle, get_actor, kill, method  # noqa: F401
 from ray_tpu.remote_function import RemoteFunction
 
 __version__ = "0.1.0"

@@ -117,6 +117,8 @@ class Controller:
         # submission_id -> {entrypoint, status, message, node_id, start/end,
         # metadata, runtime_env}. Driver subprocesses run on a node agent.
         self.jobs: dict[str, dict] = {}
+        # (metric name, sorted tag tuple) -> aggregated series
+        self.metrics: dict[tuple, dict] = {}
         # task_id -> (force, expiry), for cancels that land while the task is
         # queued or mid-dispatch (neither pending nor dispatched yet).
         # Entries expire so cancels racing completion (or actor-method refs
@@ -317,6 +319,8 @@ class Controller:
             conn.meta.update(kind="node", node_id=nid)
             self._retry_pending_pgs()
             self._kick()
+            self._publish("node", {"node_id": nid, "alive": True,
+                                   "resources": node.total.to_dict()})
             logger.info("node %s registered with %s", nid[:8], node.total.to_dict())
         else:
             wid = a["worker_id"]
@@ -934,6 +938,8 @@ class Controller:
             job["status"] = "FAILED"
             job["message"] = f"entrypoint exited with code {rc}"
         job["end_time"] = time.time()
+        self._publish("job", {"submission_id": job["submission_id"],
+                              "status": job["status"]})
 
     async def _h_stop_job(self, conn, a):
         sid = a["submission_id"]
@@ -972,6 +978,37 @@ class Controller:
                                 offset=int(a.get("offset", 0)))
 
     # -------------------------------------------------------- observability
+    async def _p_metrics_report(self, conn, a):
+        """Aggregate application metric records (reference: workers export
+        through the metrics agent to Prometheus; here the controller is the
+        aggregation point, stats/metric.h role)."""
+        for rec in a["records"]:
+            key = (rec["name"], tuple(sorted(rec["tags"].items())))
+            ent = self.metrics.get(key)
+            if ent is None:
+                ent = self.metrics[key] = {
+                    "name": rec["name"], "kind": rec["kind"],
+                    "desc": rec.get("desc", ""), "tags": rec["tags"],
+                    "value": 0.0, "count": 0, "sum": 0.0, "buckets": None,
+                }
+            kind = rec["kind"]
+            if kind == "counter":
+                ent["value"] += rec["value"]
+            elif kind == "gauge":
+                ent["value"] = rec["value"]
+            elif kind == "histogram":
+                if ent["buckets"] is None:
+                    ent["boundaries"] = rec["boundaries"]
+                    ent["buckets"] = [0] * (len(rec["boundaries"]) + 1)
+                import bisect
+
+                ent["buckets"][bisect.bisect_left(ent["boundaries"], rec["value"])] += 1
+                ent["count"] += 1
+                ent["sum"] += rec["value"]
+
+    async def _h_get_metrics(self, conn, a):
+        return {"metrics": list(self.metrics.values())}
+
     async def _p_task_events(self, conn, a):
         self.task_events.extend(a["events"])
 
@@ -1032,6 +1069,34 @@ class Controller:
     def _any_log_sub(self) -> bool:
         return any(c.meta.get("log_sub") and not c.closed
                    for c in self.client_conns.values())
+
+    # ------------------------------------------------------------- pubsub
+    # Reference src/ray/pubsub/publisher.h:300 (GCS pubsub channels for
+    # actor state / node / job / error events) + user-defined channels.
+    async def _h_subscribe(self, conn, a):
+        subs = conn.meta.setdefault("subs", set())
+        for ch in a.get("channels", ()):
+            subs.add(ch)
+        for ch in a.get("unsubscribe", ()):
+            subs.discard(ch)
+        return {"channels": sorted(subs)}
+
+    async def _p_publish(self, conn, a):
+        self._publish(a["channel"], a["payload"])
+
+    def _publish_actor_state(self, ent) -> None:
+        self._publish("actor", {
+            "actor_id": ent.spec.actor_id, "state": ent.state,
+            "name": ent.name, "node_id": ent.node_id,
+            "restarts_used": ent.restarts_used})
+
+    def _publish(self, channel: str, payload):
+        for c in self.client_conns.values():
+            if not c.closed and channel in (c.meta.get("subs") or ()):
+                try:
+                    c.push_threadsafe("pubsub", channel=channel, payload=payload)
+                except Exception:
+                    pass
 
     async def _h_subscribe_logs(self, conn, a):
         conn.meta["log_sub"] = bool(a.get("on", True))
@@ -1217,12 +1282,14 @@ class Controller:
         if a.get("error") is not None:
             # Actor __init__ raised: actor is DEAD with that cause.
             ent.state = "DEAD"
+            self._publish_actor_state(ent)
             ent.death_cause = a["error"]
             self._release_actor_resources(ent)
             self._mark_dirty()
             ent.wake()
             return
         ent.state = "ALIVE"
+        self._publish_actor_state(ent)
         ent.address = tuple(a["actor_address"])
         if ent.worker_id:
             self._actor_host_workers.add(ent.worker_id)
@@ -1306,6 +1373,7 @@ class Controller:
         from ray_tpu._private.serialization import dumps_oob
 
         ent.state = "DEAD"
+        self._publish_actor_state(ent)
         h, b = dumps_oob({"type": "ActorDiedError", "message": reason})
         ent.death_cause = [h, *b]
         self._release_actor_resources(ent)
@@ -1337,6 +1405,7 @@ class Controller:
         if max_restarts == -1 or ent.restarts_used < max_restarts:
             ent.restarts_used += 1
             ent.state = "RESTARTING"
+            self._publish_actor_state(ent)
             ent.address = None
             logger.info("restarting actor %s (%d used): %s", ent.spec.name, ent.restarts_used, reason)
             respawn = ent.spec
@@ -1345,6 +1414,7 @@ class Controller:
             self._kick()
         else:
             ent.state = "DEAD"
+            self._publish_actor_state(ent)
             from ray_tpu._private.serialization import dumps_oob
 
             h, b = dumps_oob({"type": "ActorDiedError", "message": reason})
@@ -1408,6 +1478,7 @@ class Controller:
         node.alive = False
         self.node_conns.pop(nid, None)
         logger.warning("node %s died", nid[:8])
+        self._publish("node", {"node_id": nid, "alive": False})
         # Invalidate leases whose worker lived there.
         for lease_id, ent in list(self.leases.items()):
             if ent["node_id"] == nid:

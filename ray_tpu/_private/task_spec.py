@@ -75,6 +75,9 @@ class TaskSpec:
     lifetime: Optional[str] = None
     # retry bookkeeping (mutated by controller):
     attempt: int = 0
+    #: Actor concurrency groups: {group_name: max_concurrency} (reference
+    #: concurrency_group_manager.h); methods opt in via @ray_tpu.method.
+    concurrency_groups: Optional[dict] = None
 
     def __getstate__(self):
         return (self.task_id, self.kind, self.name, self.function_id,
@@ -84,11 +87,13 @@ class TaskSpec:
                 self.owner_addr, self.actor_id, self.max_restarts,
                 self.max_task_retries, self.max_concurrency, self.actor_name,
                 self.namespace, self.get_if_exists, self.lifetime,
-                self.attempt)
+                self.attempt, self.concurrency_groups)
 
     def __setstate__(self, s):
-        if len(s) == 22:  # pre-'lifetime' snapshots: default None
-            s = s[:21] + (None,) + s[21:]
+        if len(s) == 23:  # pre-'lifetime' snapshots: insert None before attempt
+            s = s[:22] + (None,) + s[22:]
+        if len(s) == 24:  # pre-'concurrency_groups' snapshots
+            s = s + (None,)
         (self.task_id, self.kind, self.name, self.function_id,
          self.method_name, self.args, self.kwargs, self.num_returns,
          self.resources, self.strategy, self.max_retries,
@@ -96,7 +101,7 @@ class TaskSpec:
          self.owner_addr, self.actor_id, self.max_restarts,
          self.max_task_retries, self.max_concurrency, self.actor_name,
          self.namespace, self.get_if_exists, self.lifetime,
-         self.attempt) = s
+         self.attempt, self.concurrency_groups) = s
 
     def clone(self) -> "TaskSpec":
         """Shallow copy with its own SchedulingStrategy. The controller
@@ -148,6 +153,7 @@ class TaskSpec:
         sp.get_if_exists = False
         sp.lifetime = None
         sp.attempt = attempt
+        sp.concurrency_groups = None
         return sp
 
     def actor_call_tuple(self) -> tuple:

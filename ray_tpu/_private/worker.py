@@ -248,6 +248,8 @@ class Worker:
         # Pull admission control (reference pull_manager.h:49).
         self._pull_cv = threading.Condition()
         self._pull_inflight = 0
+        # Pubsub fan-in (util/pubsub.Subscriber callbacks).
+        self.pubsub_listeners: list = []
         self._escaped: set[str] = set()  # owned oids advertised on escape
         # Oids whose resolution came FROM the controller (queued-path
         # object_ready / object_lost): the controller holds directory state
@@ -391,7 +393,13 @@ class Worker:
                 self.task_cancel_handler(a["task_id"])
 
     async def _on_ctrl_push(self, conn, method, a):
-        if method == "lease_invalid":
+        if method == "pubsub":
+            for cb in list(self.pubsub_listeners):
+                try:
+                    cb(a["channel"], a["payload"])
+                except Exception:
+                    pass
+        elif method == "lease_invalid":
             self.lease_mgr.on_lease_invalid(a["lease_id"], cause=a.get("cause"))
         elif method == "need_resources":
             self.lease_mgr.on_need_resources()
@@ -1040,6 +1048,10 @@ class Worker:
     def submit_task(self, fn, args, kwargs, *, name=None, num_returns=1, resources: ResourceSet,
                     strategy: SchedulingStrategy | None = None, max_retries: int | None = None,
                     retry_exceptions=False, runtime_env=None) -> list[ObjectRef]:
+        if runtime_env:
+            from ray_tpu._private import runtime_env as _rtenv
+
+            runtime_env = _rtenv.package(self, runtime_env)
         fid = self._register_function(fn)
         enc_args, enc_kwargs, escapes = (self._encode_args(args, kwargs)
                                          if (args or kwargs) else ([], {}, []))
@@ -1126,9 +1138,14 @@ class Worker:
                      get_if_exists=False, resources: ResourceSet,
                      strategy: SchedulingStrategy | None = None, max_restarts=0,
                      max_task_retries=0, max_concurrency=1, runtime_env=None,
-                     actor_display_name=None, lifetime=None) -> str:
+                     actor_display_name=None, lifetime=None,
+                     concurrency_groups=None) -> str:
         from ray_tpu._private.ids import ActorID
 
+        if runtime_env:
+            from ray_tpu._private import runtime_env as _rtenv
+
+            runtime_env = _rtenv.package(self, runtime_env)
         fid = self._register_function(cls)
         enc_args, enc_kwargs, escapes = self._encode_args(args, kwargs)
         # Actor init args must survive RESTARTS (the controller re-runs
@@ -1159,6 +1176,7 @@ class Worker:
             namespace=namespace,
             get_if_exists=get_if_exists,
             lifetime=lifetime,
+            concurrency_groups=dict(concurrency_groups) if concurrency_groups else None,
         )
         rep = self.io.run(self.controller.call("create_actor", spec=spec))
         return rep["actor_id"]

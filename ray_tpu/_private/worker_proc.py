@@ -91,6 +91,9 @@ class WorkerProc:
         self._method_cache: dict = {}  # method name -> (bound method, is_coro)
         self.actor_id: str | None = None
         self.actor_max_concurrency = 1
+        self.actor_concurrency_groups: dict = {}
+        self._group_pools: dict = {}
+        self._group_budgets: dict = {}
         self._actor_pool = None  # ThreadPoolExecutor for threaded actors
         self._actor_loop = None  # EventLoopThread for async actors
         self._actor_sem: asyncio.Semaphore | None = None
@@ -242,17 +245,28 @@ class WorkerProc:
     def _dispatch_actor_task(self, spec: TaskSpec, reply_slot):
         """Route an actor call to the right executor: async actors run
         coroutine methods on a dedicated asyncio loop bounded by a
-        max_concurrency semaphore; threaded actors (max_concurrency>1) use a
-        thread pool; default actors execute inline in arrival order
-        (reference concurrency_group_manager.h + fiber.h for async actors)."""
+        max_concurrency semaphore; threaded actors (max_concurrency>1) and
+        methods in declared concurrency groups use per-group thread pools;
+        default actors execute inline in arrival order (reference
+        concurrency_group_manager.h + fiber.h for async actors)."""
         ent = self._method_cache.get(spec.method_name)
         if ent is None and self.actor_instance is not None:
             m = getattr(self.actor_instance, spec.method_name, None)
+            group = getattr(m, "_rt_concurrency_group", None) if m is not None else None
+            if group is not None and group not in self.actor_concurrency_groups:
+                group = None  # undeclared group: fall back to default routing
             ent = self._method_cache[spec.method_name] = (
-                m, m is not None and inspect.iscoroutinefunction(m))
+                m, m is not None and inspect.iscoroutinefunction(m), group)
+        group = ent[2] if ent is not None else None
         if ent is not None and ent[1]:
             self._ensure_actor_loop()
-            cf = asyncio.run_coroutine_threadsafe(self._a_exec_actor_task(spec), self._actor_loop.loop)
+            cf = asyncio.run_coroutine_threadsafe(
+                self._a_exec_actor_task(spec, group), self._actor_loop.loop)
+            cf.add_done_callback(
+                lambda f, rs=reply_slot, tid=spec.task_id: self._reply_future(rs, tid, f))
+        elif group is not None:
+            cf = self._group_pool(group).submit(
+                self._execute_group_task, spec, group)
             cf.add_done_callback(
                 lambda f, rs=reply_slot, tid=spec.task_id: self._reply_future(rs, tid, f))
         elif self.actor_max_concurrency > 1:
@@ -268,6 +282,37 @@ class WorkerProc:
             reply = self._execute_actor_task(spec)
             self._reply_value(reply_slot, spec.task_id, reply)
 
+    def _group_pool(self, group: str):
+        """Thread pool for one declared concurrency group (reference
+        concurrency_group_manager.h: each group owns its executor, so a
+        saturated group never blocks the others)."""
+        pool = self._group_pools.get(group)
+        if pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            limit = max(1, int(self.actor_concurrency_groups.get(group, 1)))
+            pool = self._group_pools[group] = ThreadPoolExecutor(
+                max_workers=limit, thread_name_prefix=f"rt-cg-{group}")
+        return pool
+
+    def _group_budget(self, group: str) -> threading.Semaphore:
+        """ONE concurrency budget per group shared by the sync (thread
+        pool) and async (actor loop) execution paths — a group mixing sync
+        and async methods must still honor its declared limit."""
+        sem = self._group_budgets.get(group)
+        if sem is None:
+            limit = max(1, int(self.actor_concurrency_groups.get(group, 1)))
+            sem = self._group_budgets[group] = threading.Semaphore(limit)
+        return sem
+
+    def _execute_group_task(self, spec: TaskSpec, group: str):
+        sem = self._group_budget(group)
+        sem.acquire()  # pool thread; blocking is fine
+        try:
+            return self._execute_actor_task(spec)
+        finally:
+            sem.release()
+
     def _ensure_actor_loop(self):
         if self._actor_loop is None:
             self._actor_loop = rpc.EventLoopThread(name="rt-actor-loop")
@@ -277,19 +322,37 @@ class WorkerProc:
 
             self._actor_sem = self._actor_loop.run(_mk_sem())
 
-    async def _a_exec_actor_task(self, spec: TaskSpec) -> dict:
-        async with self._actor_sem:
-            error_blob = None
-            value = None
-            t0 = time.time()
-            try:
-                method = getattr(self.actor_instance, spec.method_name)
-                args, kwargs = self.worker.decode_args(spec.args, spec.kwargs)
-                value = await method(*args, **kwargs)
-            except BaseException as e:  # noqa: BLE001
-                error_blob = self._make_error_blob(spec, e)
-            self._record_event(spec, t0, time.time(), error_blob is None)
-            return self._finish_actor_task(spec, value, error_blob)
+    async def _a_acquire_group(self, group: str | None):
+        """Acquire the shared group budget from the actor loop without
+        blocking it (short poll; group methods are coarse-grained). None ->
+        the whole-actor max_concurrency semaphore."""
+        if group is None:
+            await self._actor_sem.acquire()
+            return self._actor_sem.release
+        sem = self._group_budget(group)
+        while not sem.acquire(blocking=False):
+            await asyncio.sleep(0.002)
+        return sem.release
+
+    async def _a_exec_actor_task(self, spec: TaskSpec, group: str | None = None) -> dict:
+        release = await self._a_acquire_group(group)
+        try:
+            return await self._a_exec_actor_task_inner(spec)
+        finally:
+            release()
+
+    async def _a_exec_actor_task_inner(self, spec: TaskSpec) -> dict:
+        error_blob = None
+        value = None
+        t0 = time.time()
+        try:
+            method = getattr(self.actor_instance, spec.method_name)
+            args, kwargs = self.worker.decode_args(spec.args, spec.kwargs)
+            value = await method(*args, **kwargs)
+        except BaseException as e:  # noqa: BLE001
+            error_blob = self._make_error_blob(spec, e)
+        self._record_event(spec, t0, time.time(), error_blob is None)
+        return self._finish_actor_task(spec, value, error_blob)
 
     def _reply_value(self, pusher, task_id: str, reply: dict):
         if pusher is not None:  # None once the holder's connection closed
@@ -432,9 +495,15 @@ class WorkerProc:
         for k, v in env_vars.items():
             saved_env[k] = os.environ.get(k)
             os.environ[k] = str(v)
+        from ray_tpu._private import runtime_env as _rtenv
+
+        undo_env = lambda: None  # noqa: E731
         self._current_task_id = spec.task_id
         t0 = time.time()
         try:
+            # Inside the try: a bad package (missing KV blob, corrupt zip)
+            # must surface as a task error, not crash the worker loop.
+            undo_env = _rtenv.apply(self.worker, spec.runtime_env)
             if spec.task_id in self._cancel_requested:
                 self._cancel_requested.discard(spec.task_id)
                 raise KeyboardInterrupt  # cancelled before it started
@@ -445,6 +514,7 @@ class WorkerProc:
                 self._method_cache.clear()
                 self.actor_id = spec.actor_id
                 self.actor_max_concurrency = max(1, spec.max_concurrency)
+                self.actor_concurrency_groups = dict(spec.concurrency_groups or {})
             else:
                 fn = self.worker.load_function(spec.function_id)
                 args, kwargs = self.worker.decode_args(spec.args, spec.kwargs)
@@ -458,6 +528,7 @@ class WorkerProc:
             self._current_task_id = None
             self._record_event(spec, t0, time.time(), error_blob is None)
             if spec.kind != ACTOR_CREATE:  # dedicated actor procs keep their env
+                undo_env()
                 for k, old in saved_env.items():
                     if old is None:
                         os.environ.pop(k, None)
@@ -505,9 +576,13 @@ class WorkerProc:
         for k, v in env_vars.items():
             saved_env[k] = os.environ.get(k)
             os.environ[k] = str(v)
+        from ray_tpu._private import runtime_env as _rtenv
+
+        undo_env = lambda: None  # noqa: E731
         self._current_task_id = spec.task_id
         t0 = time.time()
         try:
+            undo_env = _rtenv.apply(self.worker, spec.runtime_env)
             if spec.task_id in self._cancel_requested:
                 self._cancel_requested.discard(spec.task_id)
                 raise KeyboardInterrupt  # cancelled before it started
@@ -520,6 +595,7 @@ class WorkerProc:
         finally:
             self._current_task_id = None
             self._record_event(spec, t0, time.time(), error_blob is None)
+            undo_env()
             for k, old in saved_env.items():
                 if old is None:
                     os.environ.pop(k, None)

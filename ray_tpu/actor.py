@@ -19,8 +19,22 @@ _ACTOR_OPTION_KEYS = {
     "num_cpus", "num_tpus", "num_gpus", "resources", "memory", "name", "namespace",
     "get_if_exists", "max_restarts", "max_task_retries", "max_concurrency",
     "scheduling_strategy", "lifetime", "runtime_env", "placement_group",
-    "placement_group_bundle_index",
+    "placement_group_bundle_index", "concurrency_groups",
 }
+
+
+def method(*, concurrency_group: str | None = None, num_returns: int | None = None):
+    """Method decorator (reference python/ray/actor.py @ray.method): tags an
+    actor method with a concurrency group and/or return arity."""
+
+    def deco(fn):
+        if concurrency_group is not None:
+            fn._rt_concurrency_group = concurrency_group
+        if num_returns is not None:
+            fn._rt_num_returns = num_returns
+        return fn
+
+    return deco
 
 
 class ActorMethod:
@@ -49,9 +63,13 @@ class ActorMethod:
 
 
 class ActorHandle:
-    def __init__(self, actor_id: str, max_task_retries: int = 0):
+    def __init__(self, actor_id: str, max_task_retries: int = 0,
+                 method_meta: dict | None = None):
         self._actor_id = actor_id
         self._max_task_retries = max_task_retries
+        # method name -> num_returns from @ray_tpu.method(num_returns=...)
+        # (introspected at ActorClass.remote; rides pickled handles).
+        self._method_meta = method_meta or {}
 
     def __getattr__(self, name: str) -> ActorMethod:
         if name.startswith("_"):
@@ -59,7 +77,7 @@ class ActorHandle:
         # Cache in the instance dict: the next `handle.method` skips
         # __getattr__ (and the ActorMethod alloc) entirely — actor call
         # dispatch is a hot path.
-        m = ActorMethod(self, name)
+        m = ActorMethod(self, name, self._method_meta.get(name, 1))
         self.__dict__[name] = m
         return m
 
@@ -68,7 +86,8 @@ class ActorHandle:
 
     def __reduce__(self):
         # NB: cached ActorMethods in __dict__ are deliberately not pickled.
-        return (ActorHandle, (self._actor_id, self._max_task_retries))
+        return (ActorHandle,
+                (self._actor_id, self._max_task_retries, self._method_meta))
 
     def __hash__(self):
         return hash(self._actor_id)
@@ -128,11 +147,16 @@ class ActorClass:
             max_restarts=o.get("max_restarts", 0),
             max_task_retries=o.get("max_task_retries", 0),
             max_concurrency=o.get("max_concurrency", 1),
+            concurrency_groups=o.get("concurrency_groups"),
             runtime_env=o.get("runtime_env"),
             actor_display_name=self._cls.__name__,
             lifetime=None if lifetime == "non_detached" else lifetime,
         )
-        return ActorHandle(actor_id, max_task_retries=o.get("max_task_retries", 0))
+        meta = {name: getattr(fn, "_rt_num_returns")
+                for name, fn in vars(self._cls).items()
+                if callable(fn) and hasattr(fn, "_rt_num_returns")}
+        return ActorHandle(actor_id, max_task_retries=o.get("max_task_retries", 0),
+                           method_meta=meta)
 
     def __call__(self, *args, **kwargs):
         raise TypeError(

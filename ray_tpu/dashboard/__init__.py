@@ -76,6 +76,8 @@ class Dashboard:
             app.router.add_get("/api/objects", self._objects)
             app.router.add_get("/api/jobs", self._jobs)
             app.router.add_get("/api/timeline", self._timeline)
+            app.router.add_get("/api/metrics", self._metrics_json)
+            app.router.add_get("/metrics", self._metrics_prom)
             runner = web.AppRunner(app, access_log=None)
             await runner.setup()
             site = web.TCPSite(runner, self.host, self.port)
@@ -157,6 +159,54 @@ class Dashboard:
 
         rep = await self._a_call("list_jobs")
         return web.json_response({"jobs": rep["jobs"]})
+
+    async def _metrics_json(self, request):
+        from aiohttp import web
+
+        rep = await self._a_call("get_metrics")
+        return web.json_response({"metrics": rep["metrics"]})
+
+    async def _metrics_prom(self, request):
+        """Prometheus exposition text (reference: the dashboard's metrics
+        endpoint scraped by Prometheus)."""
+        from aiohttp import web
+
+        rep = await self._a_call("get_metrics")
+        lines = []
+        seen_help = set()
+
+        def esc(v) -> str:
+            # Prometheus label-value escaping: backslash, quote, newline.
+            return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+                    .replace("\n", "\\n"))
+
+        for m in rep["metrics"]:
+            name = m["name"].replace(".", "_").replace("-", "_")
+            if name not in seen_help:
+                seen_help.add(name)
+                kind = {"counter": "counter", "gauge": "gauge",
+                        "histogram": "histogram"}[m["kind"]]
+                if m.get("desc"):
+                    lines.append(f"# HELP {name} {m['desc']}")
+                lines.append(f"# TYPE {name} {kind}")
+            tag_str = ",".join(f'{k}="{esc(v)}"' for k, v in sorted(m["tags"].items()))
+            label = f"{{{tag_str}}}" if tag_str else ""
+            if m["kind"] == "histogram" and m.get("buckets") is not None:
+                cum = 0
+                for bound, n in zip(m["boundaries"], m["buckets"]):
+                    cum += n
+                    sep = "," if tag_str else ""
+                    lines.append(
+                        f'{name}_bucket{{{tag_str}{sep}le="{bound}"}} {cum}')
+                cum += m["buckets"][-1]
+                sep = "," if tag_str else ""
+                lines.append(f'{name}_bucket{{{tag_str}{sep}le="+Inf"}} {cum}')
+                lines.append(f"{name}_sum{label} {m['sum']}")
+                lines.append(f"{name}_count{label} {m['count']}")
+            else:
+                lines.append(f"{name}{label} {m['value']}")
+        return web.Response(text="\n".join(lines) + "\n",
+                            content_type="text/plain")
 
     async def _timeline(self, request):
         from aiohttp import web

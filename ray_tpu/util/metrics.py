@@ -1,0 +1,119 @@
+"""Application metrics: Counter / Gauge / Histogram.
+
+Parity target: reference python/ray/util/metrics.py (Metric:23, Counter:90,
+Gauge:158, Histogram:216) backed by src/ray/stats/metric.h. Records are
+batched from each worker to the controller (the reference exports to its
+metrics agent / Prometheus); aggregated series are served by the state API
+(`ray_tpu.util.state.metrics()`) and the dashboard's /api/metrics endpoint,
+including a Prometheus text rendering.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional, Sequence
+
+_lock = threading.Lock()
+_pending: list[dict] = []  # batched records awaiting flush
+_flusher_started = False
+_FLUSH_INTERVAL_S = 1.0
+
+
+def _flush_loop():
+    while True:
+        time.sleep(_FLUSH_INTERVAL_S)
+        _flush_now()
+
+
+def _flush_now():
+    from ray_tpu._private.worker import global_worker
+
+    with _lock:
+        global _pending
+        if not _pending:
+            return
+        batch, _pending = _pending, []
+    w = global_worker()
+    if w is None or getattr(w, "_shutdown", False):
+        return
+    try:
+        w.controller.push_threadsafe("metrics_report", records=batch)
+    except Exception:
+        pass
+
+
+def _record(rec: dict):
+    global _flusher_started
+    with _lock:
+        _pending.append(rec)
+        if not _flusher_started:
+            _flusher_started = True
+            threading.Thread(target=_flush_loop, daemon=True,
+                             name="rt-metrics-flush").start()
+
+
+class Metric:
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Optional[Sequence[str]] = None):
+        if not name:
+            raise ValueError("metric name is required")
+        self._name = name
+        self._description = description
+        self._tag_keys = tuple(tag_keys or ())
+        self._default_tags: dict = {}
+
+    def set_default_tags(self, tags: dict) -> "Metric":
+        self._default_tags = dict(tags)
+        return self
+
+    def _tags(self, tags: Optional[dict]) -> dict:
+        merged = dict(self._default_tags)
+        if tags:
+            merged.update(tags)
+        extra = set(merged) - set(self._tag_keys)
+        if extra:
+            raise ValueError(f"unknown tag keys {sorted(extra)}; declared {self._tag_keys}")
+        return merged
+
+    @property
+    def info(self) -> dict:
+        return {"name": self._name, "description": self._description,
+                "tag_keys": self._tag_keys}
+
+
+class Counter(Metric):
+    """Monotonically increasing value (reference metrics.py:90)."""
+
+    def inc(self, value: float = 1.0, tags: Optional[dict] = None):
+        if value <= 0:
+            raise ValueError("Counter.inc requires value > 0")
+        _record({"kind": "counter", "name": self._name,
+                 "desc": self._description, "tags": self._tags(tags),
+                 "value": float(value)})
+
+
+class Gauge(Metric):
+    """Last-value-wins measurement (reference metrics.py:158)."""
+
+    def set(self, value: float, tags: Optional[dict] = None):
+        _record({"kind": "gauge", "name": self._name,
+                 "desc": self._description, "tags": self._tags(tags),
+                 "value": float(value)})
+
+
+class Histogram(Metric):
+    """Bucketed distribution (reference metrics.py:216)."""
+
+    def __init__(self, name: str, description: str = "",
+                 boundaries: Optional[Sequence[float]] = None,
+                 tag_keys: Optional[Sequence[str]] = None):
+        super().__init__(name, description, tag_keys)
+        if not boundaries:
+            raise ValueError("Histogram requires bucket boundaries")
+        self._boundaries = sorted(float(b) for b in boundaries)
+
+    def observe(self, value: float, tags: Optional[dict] = None):
+        _record({"kind": "histogram", "name": self._name,
+                 "desc": self._description, "tags": self._tags(tags),
+                 "value": float(value), "boundaries": self._boundaries})

@@ -44,6 +44,12 @@ def list_placement_groups() -> list[dict]:
     return [{"pg_id": pid, **info} for pid, info in snap.get("pgs", {}).items()]
 
 
+def metrics() -> list[dict]:
+    """Aggregated application metrics (ray_tpu.util.metrics Counter/Gauge/
+    Histogram series, reference `ray metrics` / Prometheus export)."""
+    return _call("get_metrics")["metrics"]
+
+
 def summarize_tasks() -> dict:
     """Counts by (name, state) — reference `ray summary tasks`."""
     out: dict = {}

@@ -1,0 +1,212 @@
+"""Application metrics + actor concurrency groups.
+
+Parity targets: reference python/ray/util/metrics.py (Counter/Gauge/
+Histogram export) and python/ray/tests/test_concurrency_group.py
+(per-group execution limits, @ray.method(concurrency_group=...)).
+"""
+
+import time
+
+import ray_tpu
+from ray_tpu.util import state
+from ray_tpu.util.metrics import Counter, Gauge, Histogram
+
+
+def _wait_for(pred, timeout=30.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.2)
+    raise TimeoutError(f"timed out: {what}")
+
+
+def test_metrics_roundtrip(ray_start_2cpu):
+    c = Counter("requests_total", description="reqs", tag_keys=("route",))
+    c.inc(tags={"route": "/a"})
+    c.inc(2, tags={"route": "/a"})
+    g = Gauge("queue_depth", tag_keys=())
+    g.set(7)
+    h = Histogram("latency_ms", boundaries=[1, 10, 100], tag_keys=())
+    for v in (0.5, 5, 50, 500):
+        h.observe(v)
+
+    def _find(name):
+        return [m for m in state.metrics() if m["name"] == name]
+
+    _wait_for(lambda: _find("latency_ms"), what="metrics flushed")
+    (cnt,) = _find("requests_total")
+    assert cnt["value"] == 3.0 and cnt["tags"] == {"route": "/a"}
+    (gau,) = _find("queue_depth")
+    assert gau["value"] == 7.0
+    (hist,) = _find("latency_ms")
+    assert hist["count"] == 4 and hist["buckets"] == [1, 1, 1, 1]
+
+
+def test_metrics_from_remote_worker(ray_start_2cpu):
+    @ray_tpu.remote
+    def work():
+        from ray_tpu.util.metrics import Counter as C
+
+        c = C("worker_side_total", tag_keys=())
+        c.inc(5)
+        from ray_tpu.util.metrics import _flush_now
+
+        _flush_now()  # don't wait out the 1s flush tick in a short task
+        return True
+
+    assert ray_tpu.get(work.remote(), timeout=60)
+    _wait_for(lambda: any(m["name"] == "worker_side_total" and m["value"] == 5.0
+                          for m in state.metrics()),
+              what="worker metric aggregated")
+
+
+def test_concurrency_groups_parallelism(ray_start_2cpu):
+    """Two calls in a group with limit 2 overlap; the default group (limit 1)
+    stays serial and is NOT blocked by a saturated other group."""
+
+    @ray_tpu.remote(concurrency_groups={"io": 2})
+    class G:
+        def __init__(self):
+            self.t0 = time.monotonic()
+
+        @ray_tpu.method(concurrency_group="io")
+        def io_sleep(self):
+            time.sleep(1.0)
+            return time.monotonic() - self.t0
+
+        def quick(self):
+            return "ok"
+
+    g = G.remote()
+    t0 = time.monotonic()
+    r1 = g.io_sleep.remote()
+    r2 = g.io_sleep.remote()
+    # Saturate "io", then call the default group: it must not queue behind.
+    time.sleep(0.1)
+    assert ray_tpu.get(g.quick.remote(), timeout=30) == "ok"
+    assert time.monotonic() - t0 < 0.9, "default group blocked behind io group"
+    ray_tpu.get([r1, r2], timeout=60)
+    # Both io calls ran concurrently: wall time ~1s, not ~2s.
+    assert time.monotonic() - t0 < 1.9
+
+
+def test_concurrency_group_async_actor(ray_start_2cpu):
+    @ray_tpu.remote(concurrency_groups={"slow": 2})
+    class A:
+        @ray_tpu.method(concurrency_group="slow")
+        async def nap(self):
+            import asyncio
+
+            await asyncio.sleep(0.8)
+            return 1
+
+        async def ping(self):
+            return "pong"
+
+    a = A.remote()
+    t0 = time.monotonic()
+    refs = [a.nap.remote(), a.nap.remote()]
+    assert ray_tpu.get(a.ping.remote(), timeout=30) == "pong"
+    assert sum(ray_tpu.get(refs, timeout=60)) == 2
+    assert time.monotonic() - t0 < 1.7  # 2 naps overlapped in the group
+
+
+def test_pubsub_actor_channel_and_user_channel(ray_start_2cpu):
+    """Subscribers see controller-published actor lifecycle events and
+    application events (reference GCS pubsub, pubsub/publisher.h:300)."""
+    from ray_tpu.util import pubsub
+
+    sub = pubsub.subscribe(["actor", "custom"])
+    try:
+        @ray_tpu.remote
+        class P:
+            def hi(self):
+                return "hi"
+
+        p = P.remote()
+        assert ray_tpu.get(p.hi.remote(), timeout=60) == "hi"
+        ev = sub.poll(timeout=30)
+        assert ev is not None and ev[0] == "actor"
+        assert ev[1]["state"] in ("ALIVE", "RESTARTING", "DEAD")
+
+        pubsub.publish("custom", {"k": 41})
+        for _ in range(50):
+            ev = sub.poll(timeout=10)
+            assert ev is not None, "no custom event arrived"
+            if ev[0] == "custom":
+                assert ev[1] == {"k": 41}
+                break
+        else:
+            raise AssertionError("custom channel event not seen")
+    finally:
+        sub.close()
+
+
+def test_prometheus_endpoint(ray_start_2cpu):
+    import urllib.request
+
+    from ray_tpu.dashboard import start_dashboard
+    from ray_tpu.util.metrics import Counter, _flush_now
+
+    Counter("prom_check_total", tag_keys=()).inc(3)
+    _flush_now()
+    time.sleep(0.3)
+    d = start_dashboard(port=0)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{d.port}/metrics", timeout=10) as r:
+            text = r.read().decode()
+        assert "# TYPE prom_check_total counter" in text, text
+        assert "prom_check_total 3.0" in text, text
+    finally:
+        d.stop()
+
+
+def test_mixed_sync_async_group_shares_budget(ray_start_2cpu):
+    """A group with limit 1 holding one sync and one async method must never
+    run both at once (single shared budget across executor paths)."""
+
+    @ray_tpu.remote(concurrency_groups={"x": 1})
+    class M:
+        def __init__(self):
+            self.active = 0
+            self.max_active = 0
+
+        def _enter(self):
+            self.active += 1
+            self.max_active = max(self.max_active, self.active)
+
+        @ray_tpu.method(concurrency_group="x")
+        def sync_op(self):
+            self._enter()
+            time.sleep(0.4)
+            self.active -= 1
+
+        @ray_tpu.method(concurrency_group="x")
+        async def async_op(self):
+            import asyncio
+
+            self._enter()
+            await asyncio.sleep(0.4)
+            self.active -= 1
+
+        def peak(self):
+            return self.max_active
+
+    m = M.remote()
+    refs = [m.sync_op.remote(), m.async_op.remote(), m.sync_op.remote()]
+    ray_tpu.get(refs, timeout=60)
+    assert ray_tpu.get(m.peak.remote(), timeout=30) == 1
+
+
+def test_method_num_returns(ray_start_2cpu):
+    @ray_tpu.remote
+    class Two:
+        @ray_tpu.method(num_returns=2)
+        def pair(self):
+            return 1, 2
+
+    t = Two.remote()
+    a, b = t.pair.remote()
+    assert ray_tpu.get([a, b], timeout=30) == [1, 2]
