@@ -33,7 +33,22 @@ BASELINES = {
     "single_client_put_gigabytes": 19.4,
 }
 
-V5E_PEAK_BF16_FLOPS = 197e12  # TPU v5e peak bf16
+# Peak bf16 FLOP/s by device kind (public spec sheets); used for the MFU
+# line. Unknown kinds fall back to the raw TFLOP/s number with no % claim.
+TPU_PEAK_BF16 = {
+    "TPU v4": 275e12,
+    "TPU v5 lite": 197e12, "TPU v5e": 197e12,
+    "TPU v5p": 459e12, "TPU v5": 459e12,
+    "TPU v6 lite": 918e12, "TPU v6e": 918e12,
+}
+
+
+def tpu_peak_flops(dev) -> tuple[float | None, str]:
+    kind = getattr(dev, "device_kind", "") or ""
+    for k, v in TPU_PEAK_BF16.items():
+        if kind.lower().startswith(k.lower()):
+            return v, kind
+    return None, kind or "unknown TPU"
 
 
 def log(msg):
@@ -131,6 +146,52 @@ def main():
     results["single_client_put_gigabytes"] = timeit(
         "single client put gigabytes", put_big, multiplier=gb)
 
+    # ---- compiled-graph channel round-trip (native futex ring) -----------
+    try:
+        import multiprocessing as mp
+        import time as _time
+
+        from ray_tpu.experimental.channel import Channel
+
+        name = f"bench_{os.getpid()}"
+        req, rep = Channel(name + "_q"), Channel(name + "_p")
+        nmsg = 2000
+
+        def _echo(nm, k):
+            import sys as _s
+
+            _s.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from ray_tpu.experimental.channel import Channel as C
+
+            a, b = C(nm + "_q", _create=False), C(nm + "_p", _create=False)
+            for _ in range(k):
+                b.write(a.read(timeout=60))
+
+        proc = mp.get_context("fork").Process(target=_echo, args=(name, nmsg),
+                                              daemon=True)
+        proc.start()
+        try:
+            payload = b"x" * 64
+            for _ in range(50):  # warm
+                req.write(payload)
+                rep.read(timeout=60)
+            t0 = _time.perf_counter()
+            for _ in range(nmsg - 50):
+                req.write(payload)
+                rep.read(timeout=60)
+            rt_us = (_time.perf_counter() - t0) / (nmsg - 50) * 1e6
+            results["channel_rtt_us"] = rt_us
+            log(f"  compiled-graph channel: {rt_us:.1f} us/round-trip "
+                f"(shm futex ring, cross-process)")
+        finally:
+            proc.join(timeout=10)
+            if proc.is_alive():
+                proc.terminate()
+            req.close(unlink=True)
+            rep.close(unlink=True)
+    except Exception as e:
+        log(f"  channel bench skipped: {e}")
+
     # ---- TPU matmul MFU (single chip), when a TPU is reachable -----------
     mfu = None
     try:
@@ -162,9 +223,14 @@ def main():
             t_long = min(run(130) for _ in range(3))
             per_matmul = (t_long - t_short) / 128
             flops = 2 * n**3 / per_matmul
-            mfu = flops / V5E_PEAK_BF16_FLOPS
             results["tpu_matmul_tflops"] = flops / 1e12
-            log(f"  tpu matmul: {flops/1e12:.1f} TFLOP/s ({mfu*100:.1f}% of v5e bf16 peak)")
+            peak, kind = tpu_peak_flops(jax.devices()[0])
+            if peak is not None:
+                mfu = flops / peak
+                log(f"  tpu matmul: {flops/1e12:.1f} TFLOP/s "
+                    f"({mfu*100:.1f}% of {kind} bf16 peak)")
+            else:
+                log(f"  tpu matmul: {flops/1e12:.1f} TFLOP/s ({kind})")
     except Exception as e:  # no TPU in this environment
         log(f"  tpu matmul skipped: {e}")
 

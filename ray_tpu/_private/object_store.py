@@ -114,6 +114,26 @@ class LocalStore:
         except (BufferError, ValueError):
             pass
 
+    @staticmethod
+    def _copy_in(mm, off: int, p) -> int:
+        """One part into the segment; multi-MB buffers use the native
+        threaded memcpy (ray_tpu/_native) when available — on many-core TPU
+        hosts a single-threaded copy leaves most of the memory bandwidth on
+        the table (cf. reference plasma's threaded CreateAndSeal copies)."""
+        if not isinstance(p, (bytes, bytearray)):
+            p = memoryview(p).cast("B")  # write raw buffer, no copy
+        n = len(p)
+        if n >= (8 << 20) and (os.cpu_count() or 1) > 2:
+            try:
+                from ray_tpu import _native
+
+                if _native.parallel_memcpy(memoryview(mm)[off:off + n], p):
+                    return n
+            except Exception:
+                pass  # fall back to the plain slice copy
+        mm[off : off + n] = p
+        return n
+
     def put(self, oid: str, parts: list) -> int:
         """Write a flattened object blob (list of bytes-like) into shm.
         Returns total size. Idempotent per oid."""
@@ -150,10 +170,7 @@ class LocalStore:
                     os.close(fd)
             off = 0
             for p in parts:
-                if not isinstance(p, (bytes, bytearray)):
-                    p = memoryview(p).cast("B")  # write raw buffer, no copy
-                mm[off : off + len(p)] = p
-                off += len(p)
+                off += self._copy_in(mm, off, p)
             if sp is not None:
                 try:
                     os.rename(sp["path"], path)
@@ -168,10 +185,7 @@ class LocalStore:
                         os.close(fd)
                     off = 0
                     for p in parts:
-                        if not isinstance(p, (bytes, bytearray)):
-                            p = memoryview(p).cast("B")
-                        mm[off : off + len(p)] = p
-                        off += len(p)
+                        off += self._copy_in(mm, off, p)
             self._objects[oid] = {
                 "size": total,
                 "cap": cap,

@@ -1,0 +1,105 @@
+"""Native (C++) runtime components: futex ring channel + parallel memcpy.
+
+Parity rationale: the reference's channel/object hot paths are C++
+(experimental_mutable_object_manager.h, plasma); ray_tpu/_native/ring.cc is
+the TPU-host equivalent, JIT-built with g++ and bound via ctypes with a
+pure-Python fallback.
+"""
+
+import multiprocessing as mp
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu._native import get_lib, parallel_memcpy
+
+
+def test_native_lib_builds():
+    assert get_lib() is not None, "g++ toolchain present; native must build"
+
+
+def test_parallel_memcpy_correctness():
+    a = np.random.default_rng(0).integers(0, 256, size=9_000_000, dtype=np.uint8)
+    dst = bytearray(len(a))
+    assert parallel_memcpy(memoryview(dst), a)
+    assert bytes(dst) == a.tobytes()
+
+
+REPO = __import__("os").path.dirname(__import__("os").path.dirname(
+    __import__("os").path.abspath(__file__)))
+
+
+def _child_echo(name, n_msgs):
+    import sys
+
+    sys.path.insert(0, REPO)
+    from ray_tpu.experimental.channel import Channel
+
+    a = Channel(name + "_req", _create=False)
+    b = Channel(name + "_rep", _create=False)
+    for _ in range(n_msgs):
+        b.write(a.read(timeout=30))
+
+
+def test_channel_native_roundtrip_cross_process(tmp_path):
+    from ray_tpu.experimental.channel import Channel
+
+    name = f"tnat_{time.time_ns()}"
+    req = Channel(name + "_req")
+    rep = Channel(name + "_rep")
+    n = 300
+    p = mp.get_context("spawn").Process(target=_child_echo, args=(name, n),
+                                        daemon=True)
+    p.start()
+    try:
+        t0 = time.perf_counter()
+        for i in range(n):
+            req.write({"i": i, "data": b"x" * 256})
+            out = rep.read(timeout=30)
+            assert out["i"] == i
+        dt = time.perf_counter() - t0
+        # Sanity: futex path must stay well under the Python-poll baseline.
+        assert dt / n < 0.05, f"{dt/n*1e6:.0f}us per round trip"
+    finally:
+        p.join(timeout=30)
+        req.close(unlink=True)
+        rep.close(unlink=True)
+
+
+def test_channel_python_fallback_interops(monkeypatch, tmp_path):
+    """A reader forced onto the pure-Python path still talks to a native
+    writer (shared header layout; bounded native waits)."""
+    import ray_tpu._native as native
+    from ray_tpu.experimental import channel as chmod
+
+    name = f"tfall_{time.time_ns()}"
+    w = chmod.Channel(name)
+    monkeypatch.setattr(chmod, "_native", lambda: None)
+    r = chmod.Channel(name, _create=False)
+    assert r._lib is None and w._lib is not None
+    w.write([1, 2, 3])
+    assert r.read(timeout=10) == [1, 2, 3]
+    w.write("second")
+    assert r.read(timeout=10) == "second"
+    w.close()
+    r.close(unlink=True)
+
+
+def test_ring_copying_read_roundtrip():
+    """rt_ring_read (copy-out variant of the wait/ack pair) stays correct."""
+    import ctypes
+    import mmap
+
+    lib = get_lib()
+    size = 1 << 12
+    mm = mmap.mmap(-1, 64 + size)
+    view = (ctypes.c_char * len(mm)).from_buffer(mm)
+    base = ctypes.addressof(view)
+    msg = b"copying-read-path" * 3
+    assert lib.rt_ring_write(base, size, msg, len(msg), int(1e9)) == 0
+    out = ctypes.create_string_buffer(size)
+    n = lib.rt_ring_read(base, size, out, 0, int(1e9))
+    assert n == len(msg) and out.raw[:n] == msg
+    assert lib.rt_ring_read(base, size, out, 1, int(20e6)) == -1  # timeout
+    del view
