@@ -369,7 +369,8 @@ class Controller:
                 still_pending.append(spec)
                 continue
             demand = ResourceSet(_raw=spec.resources)
-            nid = pick_node(demand, spec.strategy, self.nodes, self.pg_bundles)
+            nid = pick_node(demand, spec.strategy, self.nodes, self.pg_bundles,
+                            preferred=self._locality_nodes(spec))
             if nid is None:
                 failed_sigs.add(sig)
                 still_pending.append(spec)
@@ -379,6 +380,25 @@ class Controller:
         self.pending.extend(still_pending)
         if still_pending:
             self._maybe_push_need_resources()
+
+    def _locality_nodes(self, spec: TaskSpec) -> dict:
+        """node_id -> bytes of this spec's ref arguments already resident
+        there (feeds pick_node's locality preference; reference
+        dependency_manager.h's locality-aware dispatch)."""
+        out: dict[str, int] = {}
+        addr_to_node = None
+        for oid in spec.ref_arg_oids():
+            ent = self.objects.get(oid)
+            if ent is None or not ent.holders or not ent.size:
+                continue
+            if addr_to_node is None:
+                addr_to_node = {tuple(n.address): nid
+                                for nid, n in self.nodes.items() if n.alive}
+            for h in ent.holders:
+                nid = addr_to_node.get(tuple(h))
+                if nid is not None:
+                    out[nid] = out.get(nid, 0) + ent.size
+        return out
 
     async def _dispatch_bg(self, nid: str, spec: TaskSpec, demand: ResourceSet):
         ok = await self._dispatch(nid, spec)

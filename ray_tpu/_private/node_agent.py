@@ -373,6 +373,7 @@ class NodeAgent:
         pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
         env["PYTHONPATH"] = pkg_root + os.pathsep + env.get("PYTHONPATH", "")
         env.update(
+            RT_HOST=self.host,
             RT_WORKER_ID=wid,
             RT_NODE_ID=self.node_id,
             RT_SESSION=self.session_id,

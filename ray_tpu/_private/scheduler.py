@@ -48,11 +48,27 @@ def pick_node(
     strategy: SchedulingStrategy,
     nodes: dict[str, NodeState],
     pg_bundles: Optional[dict] = None,
+    preferred: Optional[dict] = None,
 ) -> Optional[str]:
-    """Return node_id to run on, or None if nothing is feasible right now."""
+    """Return node_id to run on, or None if nothing is feasible right now.
+
+    `preferred` maps node_id -> argument bytes already resident there
+    (locality, reference dependency_manager.h + the hybrid policy's
+    locality preference): a DEFAULT-strategy task runs where its biggest
+    arguments live when that node is feasible."""
     alive = {nid: n for nid, n in nodes.items() if n.alive and not n.draining}
     if not alive:
         return None
+
+    if preferred and strategy.kind == "DEFAULT":
+        best = None
+        for nid, nbytes in preferred.items():
+            n = alive.get(nid)
+            if n is not None and n.available.fits(demand):
+                if best is None or nbytes > best[1]:
+                    best = (nid, nbytes)
+        if best is not None:
+            return best[0]
 
     if strategy.kind == "PLACEMENT_GROUP" and pg_bundles is not None:
         # Bundles carry their own reserved resources on a pinned node.

@@ -163,6 +163,19 @@ class TaskSpec:
         return (self.task_id, self.method_name, self.args, self.kwargs,
                 self.num_returns, self.name, self.attempt)
 
+    def ref_arg_oids(self) -> list[str]:
+        """Oids of by-reference arguments — the single place that knows the
+        ('ref', oid) arg wire encoding (used by locality scheduling and
+        executor-side prefetch)."""
+        out = []
+        for a in self.args or ():
+            if isinstance(a, (tuple, list)) and a and a[0] == "ref":
+                out.append(a[1])
+        for a in (self.kwargs or {}).values():
+            if isinstance(a, (tuple, list)) and a and a[0] == "ref":
+                out.append(a[1])
+        return out
+
     def return_object_ids(self) -> list[str]:
         # Object id hex = task id hex + 4B little-endian return index hex
         # (ids.ObjectID.for_task_return) — derivable by string concat, which

@@ -250,6 +250,9 @@ class Worker:
         self._pull_inflight = 0
         # Pubsub fan-in (util/pubsub.Subscriber callbacks).
         self.pubsub_listeners: list = []
+        # Direct worker-to-worker collective messages (util/collective ring
+        # transport) — set by the collective module when a group inits.
+        self.collective_msg_cb = None
         self._escaped: set[str] = set()  # owned oids advertised on escape
         # Oids whose resolution came FROM the controller (queued-path
         # object_ready / object_lost): the controller holds directory state
@@ -289,9 +292,17 @@ class Worker:
 
     # ------------------------------------------------------------ lifecycle
     def connect(self):
+        import os as _os
+
+        # Bind on the node's externally-visible host (RT_HOST, set by the
+        # node agent from its own --host) so direct worker-to-worker
+        # connections — actor calls, leased task pushes, collective rings —
+        # work across hosts; loopback only for single-machine defaults.
+        bind_host = _os.environ.get("RT_HOST") or "127.0.0.1"
+
         async def _go():
-            await self.server.start("127.0.0.1", 0)
-            self.server_addr = ("127.0.0.1", self.server.port)
+            await self.server.start(bind_host, 0)
+            self.server_addr = (bind_host, self.server.port)
             self.controller = await rpc.connect(
                 *self.controller_addr,
                 on_push=self._on_ctrl_push,
@@ -391,6 +402,10 @@ class Worker:
         elif method == "cancel":
             if self.task_cancel_handler is not None:
                 self.task_cancel_handler(a["task_id"])
+        elif method == "col_msg":
+            cb = self.collective_msg_cb
+            if cb is not None:
+                cb(a)
 
     async def _on_ctrl_push(self, conn, method, a):
         if method == "pubsub":

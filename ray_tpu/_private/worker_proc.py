@@ -105,6 +105,7 @@ class WorkerProc:
         # in an earlier task report the cancellation immediately.
         self._pending_ltasks: dict = {}
         self._done_pushers: dict = {}  # owner conn -> _BatchPusher
+        self._prefetch_pool = None  # lazy: arg pre-localization threads
         self._advertise_pusher: _BatchPusher | None = None
         self._running = True
 
@@ -151,6 +152,34 @@ class WorkerProc:
         """Direct-path spec from a lease holder (runs on the IO loop)."""
         self._pending_ltasks[spec.task_id] = (spec, conn)
         self.exec_queue.put(("ltask", spec, conn))
+        self._prefetch_args(spec)
+
+    def _prefetch_args(self, spec: TaskSpec):
+        """Pre-localize ref arguments while the spec waits in the exec queue
+        (reference dependency_manager.h:55 localizes args BEFORE dispatch;
+        without this, fetches serialize inside the task's execution slot)."""
+        oids = spec.ref_arg_oids()
+        if not oids:
+            return
+        if self._prefetch_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._prefetch_pool = ThreadPoolExecutor(
+                max_workers=2, thread_name_prefix="rt-prefetch")
+
+        def _fetch(oid):
+            try:
+                if not self.worker.store.contains(oid):
+                    # Bounded: a never-resolving ref must not wedge the
+                    # 2-thread pool forever (the real decode_args still
+                    # owns correctness and surfaces any fetch error).
+                    self.worker._get_one(ObjectRef(oid),
+                                         deadline=time.monotonic() + 120.0)
+            except Exception:
+                pass
+
+        for oid in oids:
+            self._prefetch_pool.submit(_fetch, oid)
 
     def _pusher_for(self, conn) -> "_BatchPusher | None":
         """Per-connection batched reply pusher; None once the holder's

@@ -118,3 +118,36 @@ def test_resources_infeasible_stays_pending(ray_start_2cpu):
     ref = f.remote()
     ready, pending = ray_tpu.wait([ref], timeout=0.5)
     assert ready == [] and pending == [ref]
+
+
+def test_locality_aware_actor_placement(ray_start_cluster):
+    """A queued (controller-scheduled) actor creation with a large ref arg
+    lands on the node holding the argument (pick_node locality preference;
+    reference dependency_manager.h + hybrid policy locality)."""
+    import numpy as np
+
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2, resources={"side": 1})
+    ray_tpu.init(address=cluster.address)
+
+    @ray_tpu.remote(resources={"side": 1})
+    def make_big():
+        return np.zeros(2 * 1024 * 1024, dtype=np.uint8)  # holder: side node
+
+    big_ref = make_big.remote()
+    ray_tpu.wait([big_ref], num_returns=1, timeout=60)
+
+    @ray_tpu.remote
+    class Holder:
+        def __init__(self, arr):
+            self.n = int(arr.nbytes)
+
+        def where(self):
+            import os
+
+            return os.environ.get("RT_NODE_ID")
+
+    h = Holder.remote(big_ref)
+    node = ray_tpu.get(h.where.remote(), timeout=120)
+    assert node == cluster.nodes[0].node_id, (
+        "actor should be placed on the node holding its 2MB argument")
