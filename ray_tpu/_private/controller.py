@@ -386,16 +386,26 @@ class Controller:
         there (feeds pick_node's locality preference; reference
         dependency_manager.h's locality-aware dispatch)."""
         out: dict[str, int] = {}
-        addr_to_node = None
+        addr_to_node = host_to_node = None
         for oid in spec.ref_arg_oids():
             ent = self.objects.get(oid)
             if ent is None or not ent.holders or not ent.size:
                 continue
             if addr_to_node is None:
-                addr_to_node = {tuple(n.address): nid
-                                for nid, n in self.nodes.items() if n.alive}
+                addr_to_node = {}
+                host_counts: dict[str, list] = {}
+                for nid, n in self.nodes.items():
+                    if not n.alive:
+                        continue
+                    addr_to_node[tuple(n.address)] = nid
+                    host_counts.setdefault(n.address[0], []).append(nid)
+                # Driver puts advertise the driver's own server address
+                # (host + ephemeral port), not a node agent's: fall back to
+                # host matching when exactly one node lives on that host.
+                host_to_node = {h: nids[0] for h, nids in host_counts.items()
+                                if len(nids) == 1}
             for h in ent.holders:
-                nid = addr_to_node.get(tuple(h))
+                nid = addr_to_node.get(tuple(h)) or host_to_node.get(h[0])
                 if nid is not None:
                     out[nid] = out.get(nid, 0) + ent.size
         return out

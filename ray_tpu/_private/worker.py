@@ -692,6 +692,42 @@ class Worker:
         raise exc.ObjectLostError(
             f"object {oid[:16]} unavailable (holders {holders}): {last_err}")
 
+    def prefetch_object(self, oid: str, timeout: float = 120.0) -> None:
+        """Localize an object's BYTES into this process's reach (inline
+        cache or local shm) without deserializing — the warm-up half of
+        _get_one for executor-side arg pre-localization (reference
+        dependency_manager.h). Best-effort: failures are left for the real
+        decode to surface."""
+        if oid in self._inline_cache or self.store.contains(oid):
+            return
+        deadline = time.monotonic() + timeout
+        res = self._resolutions.get(oid)
+        if res is not None:
+            if not res.wait(timeout):
+                return
+            holders, error, inline = res.holders, res.error, res.inline
+        else:
+            rep = self.io.run(self.controller.call(
+                "wait_object", oid=oid, timeout=timeout))
+            if rep["status"] != "ready":
+                return
+            holders = [tuple(h) for h in rep.get("holders", [])]
+            error, inline = rep.get("error"), rep.get("inline")
+        if error is not None or inline is not None or not holders:
+            return  # inline/error payloads need no localization
+        import random
+
+        holders = list(holders)
+        random.shuffle(holders)
+        for holder in holders:
+            if tuple(holder) == tuple(self.server_addr):
+                return
+            try:
+                if self._fetch_from(tuple(holder), oid, deadline):
+                    return
+            except Exception:
+                continue
+
     def _acquire_pull(self, nbytes: int):
         """Admission control (reference pull_manager.h:49): bound the bytes
         in flight across concurrent fetches. A single fetch is always

@@ -169,12 +169,10 @@ class WorkerProc:
 
         def _fetch(oid):
             try:
-                if not self.worker.store.contains(oid):
-                    # Bounded: a never-resolving ref must not wedge the
-                    # 2-thread pool forever (the real decode_args still
-                    # owns correctness and surfaces any fetch error).
-                    self.worker._get_one(ObjectRef(oid),
-                                         deadline=time.monotonic() + 120.0)
+                # Localize bytes only (no deserialization — decode_args does
+                # that once, in the exec slot); bounded so a never-resolving
+                # ref can't wedge the 2-thread pool forever.
+                self.worker.prefetch_object(oid, timeout=120.0)
             except Exception:
                 pass
 

@@ -15,6 +15,7 @@ from ray_tpu.rllib.algorithm import (
     PPOConfig,
 )
 from ray_tpu.rllib.env import ENV_REGISTRY, CartPoleVecEnv, make_vec_env
+from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, IMPALALearner, IMPALALearnerConfig
 from ray_tpu.rllib.env_runner import SingleAgentEnvRunner
 from ray_tpu.rllib.learner import PPOLearner, PPOLearnerConfig, compute_gae
 from ray_tpu.rllib.rl_module import RLModule, RLModuleSpec
@@ -25,6 +26,10 @@ __all__ = [
     "CartPoleVecEnv",
     "ENV_REGISTRY",
     "EnvRunnerGroup",
+    "IMPALA",
+    "IMPALAConfig",
+    "IMPALALearner",
+    "IMPALALearnerConfig",
     "PPO",
     "PPOConfig",
     "PPOLearner",

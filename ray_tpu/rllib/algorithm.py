@@ -107,26 +107,36 @@ class Algorithm:
         self.config = config
         self.iteration = 0
 
-    def train(self) -> dict:
-        raise NotImplementedError
-
-    def stop(self):
-        pass
-
-
-class PPO(Algorithm):
-    def __init__(self, config: PPOConfig):
-        super().__init__(config)
+    def _bootstrap(self, make_learner):
+        """Shared setup for concrete algorithms: probe the env for the
+        module spec, build module + learner (via make_learner(module)) and
+        the env-runner fleet."""
+        config = self.config
         probe = make_vec_env(config.env, 1, seed=0)
         self.module_spec = RLModuleSpec(
             observation_dim=probe.observation_dim,
             action_dim=probe.action_dim,
             hidden=tuple(config.module_hidden))
         self.module = RLModule(self.module_spec)
-        self.learner = PPOLearner(self.module, config.learner,
-                                  seed=config.seed)
+        self.learner = make_learner(self.module)
         self.runners = EnvRunnerGroup(config, self.module_spec)
         self._return_window: list[float] = []
+
+    def train(self) -> dict:
+        raise NotImplementedError
+
+    def stop(self):
+        try:
+            self.runners.stop()
+        except AttributeError:
+            pass
+
+
+class PPO(Algorithm):
+    def __init__(self, config: PPOConfig):
+        super().__init__(config)
+        self._bootstrap(lambda module: PPOLearner(
+            module, config.learner, seed=config.seed))
 
     def train(self) -> dict:
         """One iteration: parallel sample -> GAE -> minibatched PPO epochs
@@ -167,5 +177,3 @@ class PPO(Algorithm):
             **{f"learner/{k}": v for k, v in stats.items()},
         }
 
-    def stop(self):
-        self.runners.stop()

@@ -72,5 +72,8 @@ class SingleAgentEnvRunner:
             "obs": obs_buf, "actions": act_buf, "logp_old": logp_buf,
             "values": val_buf, "rewards": rew_buf, "dones": done_buf,
             "last_values": np.asarray(last_values),
+            # Bootstrap observation for off-policy learners (IMPALA's
+            # V-trace re-evaluates it under the CURRENT params).
+            "last_obs": np.asarray(self.obs, dtype=np.float32),
             "episode_returns": returns,
         }

@@ -136,6 +136,14 @@ class GroupManager:
                     w.io.run(conn.close(), timeout=5)
                 except Exception:
                     pass
+            # Drop this rank's address key: a later re-init of the same
+            # group name must re-rendezvous against LIVE addresses, not
+            # this incarnation's (possibly dead) ones.
+            try:
+                w.kv("del", ns="collective",
+                     key=f"col/{group_name}/addr/{g.rank}")
+            except Exception:
+                pass
 
 
 _manager = GroupManager()

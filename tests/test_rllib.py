@@ -104,3 +104,34 @@ def test_ppo_as_tune_trainable(ray_start_4cpu, tmp_path):
     assert grid.num_errors == 0
     best = grid.get_best_result()
     assert best.config["lr"] == 3e-4  # the real lr beats the degenerate one
+
+
+def test_impala_learns_cartpole(shutdown_only):
+    """IMPALA improves CartPole return (reference
+    rllib/algorithms/impala — BASELINE.md north-star workload). The async
+    harvest loop keeps a sample in flight per runner; V-trace corrects the
+    policy lag."""
+    import ray_tpu
+    from ray_tpu.rllib import IMPALAConfig
+
+    ray_tpu.init(num_cpus=3)
+    algo = (IMPALAConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=2, num_envs_per_env_runner=8,
+                         rollout_fragment_length=64)
+            .training(updates_per_iteration=4)
+            .build())
+    try:
+        first = algo.train()
+        assert first["num_env_steps_sampled"] == 4 * 64 * 8
+        best = -1.0
+        for _ in range(24):
+            m = algo.train()
+            r = m["episode_return_mean"]
+            if r == r:  # not-NaN
+                best = max(best, r)
+        # Untrained CartPole hovers ~20; require clear learning signal
+        # (the curve reaches ~65-70 by iteration 25 on this config).
+        assert best > 55, f"IMPALA failed to learn: best return {best}"
+    finally:
+        algo.stop()
