@@ -9,6 +9,8 @@ workload, so tuning composes with training/PGs/FT for free.
 from ray_tpu.tune._session import get_checkpoint, report
 from ray_tpu.tune.schedulers import (
     ASHAScheduler,
+    HyperBandScheduler,
+    MedianStoppingRule,
     FIFOScheduler,
     PopulationBasedTraining,
 )
@@ -25,6 +27,8 @@ from ray_tpu.tune.tuner import Result, ResultGrid, TuneConfig, Tuner
 
 __all__ = [
     "ASHAScheduler",
+    "HyperBandScheduler",
+    "MedianStoppingRule",
     "FIFOScheduler",
     "PopulationBasedTraining",
     "Result",

@@ -19,8 +19,21 @@ STOP = "STOP"
 class FIFOScheduler:
     """Run every trial to completion (reference trial_scheduler.py:94)."""
 
+    metric: Optional[str] = None
+    mode: Optional[str] = None
+
     def setup(self, metric: Optional[str], mode: Optional[str]):
-        self.metric, self.mode = metric, mode
+        """Adopt the TuneConfig metric/mode unless the scheduler was built
+        with its own (shared by every metric-driven scheduler below)."""
+        self.metric = getattr(self, "metric", None) or metric
+        self.mode = getattr(self, "mode", None) or mode or "max"
+
+    def _score(self, result) -> Optional[float]:
+        """Result's metric in +is-better units (None if absent)."""
+        v = result.get(self.metric)
+        if v is None:
+            return None
+        return float(v) if self.mode == "max" else -float(v)
 
     def on_trial_result(self, controller, trial, result) -> str:
         return CONTINUE
@@ -41,6 +54,8 @@ class ASHAScheduler(FIFOScheduler):
         self.time_attr = time_attr
         self.metric, self.mode = metric, mode
         self.max_t = max_t
+        if reduction_factor <= 1:
+            raise ValueError("reduction_factor must be > 1")
         self.grace_period = grace_period
         self.rf = reduction_factor
         # rung milestones, ascending: grace, grace*rf, grace*rf^2, ... < max_t
@@ -52,15 +67,6 @@ class ASHAScheduler(FIFOScheduler):
         # rung value -> list of recorded metric values (in +is-better units)
         self._recorded: dict[float, list[float]] = {r: [] for r in self.rungs}
 
-    def setup(self, metric, mode):
-        self.metric = self.metric or metric
-        self.mode = self.mode or mode or "max"
-
-    def _score(self, result) -> Optional[float]:
-        v = result.get(self.metric)
-        if v is None:
-            return None
-        return float(v) if self.mode == "max" else -float(v)
 
     def on_trial_result(self, controller, trial, result) -> str:
         t = result.get(self.time_attr, 0)
@@ -164,3 +170,95 @@ class PopulationBasedTraining(FIFOScheduler):
 
     def on_trial_complete(self, controller, trial):
         pass
+
+
+class MedianStoppingRule(FIFOScheduler):
+    """Stop a trial whose best result so far is worse than the median of the
+    running averages of other trials at the same step (reference
+    tune/schedulers/median_stopping_rule.py: MedianStoppingRule)."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 metric: Optional[str] = None, mode: Optional[str] = None,
+                 grace_period: int = 1, min_samples_required: int = 3):
+        self.time_attr = time_attr
+        self.metric, self.mode = metric, mode
+        self.grace_period = grace_period
+        self.min_samples_required = min_samples_required
+        # trial id -> list of scores (in +is-better units)
+        self._scores: dict = {}
+
+
+    def on_trial_result(self, controller, trial, result) -> str:
+        t = result.get(self.time_attr, 0)
+        score = self._score(result)
+        if score is None:
+            return CONTINUE
+        self._scores.setdefault(trial.trial_id, []).append(score)
+        if t < self.grace_period:
+            return CONTINUE
+        # Compare against other trials' running averages UP TO this step —
+        # all-time averages would judge late starters against finished
+        # trials' full runs (reference computes the median of running
+        # averages at the same time step).
+        upto = max(1, int(t))
+        others = [vals[:upto] for tid, vals in self._scores.items()
+                  if tid != trial.trial_id and vals]
+        if len(others) < self.min_samples_required:
+            return CONTINUE
+        medians = sorted(sum(vals) / len(vals) for vals in others)
+        median = medians[len(medians) // 2]
+        best = max(self._scores[trial.trial_id])
+        return STOP if best < median else CONTINUE
+
+
+class HyperBandScheduler(FIFOScheduler):
+    """Synchronous HyperBand-style banding (reference
+    tune/schedulers/hyperband.py, simplified to a single bracket):
+    successive halving at milestones max_t/rf^k — at each milestone the
+    bottom (1 - 1/rf) fraction of trials that reported there stop."""
+
+    def __init__(self, time_attr: str = "training_iteration",
+                 metric: Optional[str] = None, mode: Optional[str] = None,
+                 max_t: int = 81, reduction_factor: float = 3):
+        self.time_attr = time_attr
+        self.metric, self.mode = metric, mode
+        if reduction_factor <= 1:
+            raise ValueError("reduction_factor must be > 1")
+        self.max_t = max_t
+        self.rf = reduction_factor
+        milestones = []
+        t = max_t
+        while t >= 1:
+            milestones.append(int(t))
+            t /= reduction_factor
+        self.milestones = sorted(set(milestones))[:-1]  # drop max_t itself
+        self._recorded: dict[int, list[float]] = {m: [] for m in self.milestones}
+        # milestone -> {trial_id: score}: cutoffs are re-evaluated on every
+        # later report, so a bad trial that crossed a milestone before its
+        # peers recorded there still gets halved once they do.
+        self._at: dict[int, dict] = {m: {} for m in self.milestones}
+
+
+    def on_trial_result(self, controller, trial, result) -> str:
+        t = result.get(self.time_attr, 0)
+        if t >= self.max_t:
+            return STOP
+        score = self._score(result)
+        if score is None:
+            return CONTINUE
+        seen = trial.sched_state.setdefault("hb_milestones", set())
+        decision = CONTINUE
+        for m in self.milestones:
+            if t < m:
+                continue
+            if m not in seen:
+                seen.add(m)
+                self._recorded[m].append(score)
+                self._at[m][trial.trial_id] = score
+            rec = self._recorded[m]
+            if len(rec) >= self.rf:
+                keep = max(1, int(len(rec) / self.rf))
+                cutoff = sorted(rec, reverse=True)[keep - 1]
+                if self._at[m][trial.trial_id] < cutoff:
+                    decision = STOP
+        return decision

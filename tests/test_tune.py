@@ -200,3 +200,57 @@ def test_trainer_in_tuner(ray_start_4cpu, tmp_path):
     ).fit()
     assert grid.num_errors == 0
     assert grid.get_best_result().config["train_loop_config"]["lr"] == 0.01
+
+
+def test_median_stopping_rule(ray_start_4cpu):
+    """Bad trials stop early once enough peers establish the median
+    (reference tune/schedulers/median_stopping_rule.py)."""
+    from ray_tpu import tune
+    from ray_tpu.tune import MedianStoppingRule, TuneConfig, Tuner
+
+    def trainable(config):
+        import time as _t
+
+        for i in range(1, 21):
+            _t.sleep(0.05)  # real iterations take time: peers interleave
+            tune.report({"score": config["q"] * i, "training_iteration": i})
+
+    tuner = Tuner(
+        trainable,
+        param_space={"q": tune.grid_search([1, 2, 10, 11, 12, 13])},
+        tune_config=TuneConfig(
+            metric="score", mode="max",
+            scheduler=MedianStoppingRule(grace_period=3,
+                                         min_samples_required=3)),
+    )
+    grid = tuner.fit()
+    best = grid.get_best_result()
+    assert best.config["q"] == 13
+    iters = {r.config["q"]: r.metrics.get("training_iteration") for r in grid}
+    # The clearly-below-median trials must have been cut early.
+    assert iters[1] < 20 and iters[2] < 20, iters
+
+
+def test_hyperband_scheduler(ray_start_4cpu):
+    from ray_tpu import tune
+    from ray_tpu.tune import HyperBandScheduler, TuneConfig, Tuner
+
+    def trainable(config):
+        import time as _t
+
+        for i in range(1, 28):
+            _t.sleep(0.04)
+            tune.report({"loss": 100.0 / config["lr_id"] - i * 0.01,
+                         "training_iteration": i})
+
+    tuner = Tuner(
+        trainable,
+        param_space={"lr_id": tune.grid_search([1, 2, 3, 4, 5, 6])},
+        tune_config=TuneConfig(
+            metric="loss", mode="min",
+            scheduler=HyperBandScheduler(max_t=27, reduction_factor=3)),
+    )
+    grid = tuner.fit()
+    assert grid.get_best_result().config["lr_id"] == 6
+    iters = {r.config["lr_id"]: r.metrics.get("training_iteration") for r in grid}
+    assert iters[1] < 27, iters  # worst trial halved out before max_t
