@@ -39,6 +39,8 @@ __all__ = [
     "Request",
     "batch",
     "delete",
+    "get_multiplexed_model_id",
+    "multiplexed",
     "deployment",
     "get_app_handle",
     "get_deployment_handle",
@@ -285,3 +287,92 @@ def batch(_func=None, *, max_batch_size: int = 8,
     if _func is not None:
         return wrap(_func)
     return wrap
+
+
+# --------------------------------------------------------------- multiplex
+def get_multiplexed_model_id() -> str:
+    """Model id of the request currently being handled (reference
+    serve.get_multiplexed_model_id) — set by handle.options(
+    multiplexed_model_id=...) or the `serve_multiplexed_model_id` HTTP
+    header."""
+    from ray_tpu.serve._private.replica import _multiplexed_model_id
+
+    return _multiplexed_model_id.get()
+
+
+def multiplexed(_func=None, *, max_num_models_per_replica: int = 3):
+    """Decorator for a deployment's model-loader method (reference
+    serve/multiplex.py @serve.multiplexed): caches up to
+    `max_num_models_per_replica` loaded models per replica with LRU
+    eviction, so one replica pool serves many fine-tuned model variants.
+
+    Usage::
+
+        @serve.deployment
+        class Multi:
+            @serve.multiplexed(max_num_models_per_replica=2)
+            async def get_model(self, model_id: str):
+                return load_model(model_id)
+
+            async def __call__(self, request):
+                model = await self.get_model(serve.get_multiplexed_model_id())
+                return model.predict(request.json())
+    """
+
+    def deco(fn):
+        cache_attr = f"__rt_mux_cache_{fn.__name__}"
+        is_coro = asyncio.iscoroutinefunction(fn)
+
+        async def _load(self, model_id: str):
+            # Replica requests interleave on ONE event loop; the cache maps
+            # model_id -> Future so concurrent requests for the same model
+            # await a single in-flight load instead of double-loading.
+            # Eviction pops the reference and lets GC reclaim the model once
+            # the last in-flight request drops it (calling a release hook
+            # here would tear down a model another request is still using).
+            cache = getattr(self, cache_attr, None)
+            if cache is None:
+                cache = {}
+                setattr(self, cache_attr, cache)
+            fut = cache.get(model_id)
+            if fut is not None:
+                cache[model_id] = cache.pop(model_id)  # LRU touch
+                return await asyncio.shield(fut)
+            loop = asyncio.get_event_loop()
+            fut = loop.create_future()
+            cache[model_id] = fut
+            try:
+                if is_coro:
+                    model = await fn(self, model_id)
+                else:
+                    # A sync loader must not freeze the replica's event loop
+                    # for the duration of a model load.
+                    model = await loop.run_in_executor(
+                        None, functools.partial(fn, self, model_id))
+            except BaseException as e:
+                cache.pop(model_id, None)
+                if not fut.done():
+                    fut.set_exception(e)
+                    # consumed by any concurrent waiter; don't warn if not
+                    fut.exception()
+                raise
+            fut.set_result(model)
+            while len(cache) > max_num_models_per_replica:
+                for mid in list(cache):
+                    if mid != model_id and cache[mid].done():
+                        del cache[mid]
+                        break
+                else:
+                    break  # everything else still loading: nothing to evict
+            return model
+
+        @functools.wraps(fn)
+        async def wrapper(self, model_id: str):
+            return await _load(self, model_id)
+
+        wrapper.__rt_multiplexed__ = True
+        return wrapper
+
+    if _func is not None:
+        return deco(_func)
+    return deco

@@ -97,13 +97,16 @@ class Proxy:
                       headers=dict(request.headers), body=body)
         router = get_router(self.controller_name, dep)
         loop = asyncio.get_event_loop()
+        # reference multiplex header: routes to a replica with the model hot.
+        model_id = request.headers.get("serve_multiplexed_model_id", "")
 
         async def _once():
             # assign only blocks when there are no replicas (rare), so the
             # executor thread is held for microseconds, not the request
             # duration; the result await costs no thread at all.
             ref = await loop.run_in_executor(
-                None, lambda: router.assign("__call__", (req,), {}))
+                None, lambda: router.assign("__call__", (req,), {},
+                                            multiplexed_model_id=model_id))
             return await self._resolver.submit(ref)
 
         try:

@@ -11,9 +11,15 @@ max_concurrent_queries).
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import inspect
 import json as _json
 from typing import Any, Optional
+
+#: Model id of the request currently being handled (reference
+#: serve.get_multiplexed_model_id / _serve_request_context).
+_multiplexed_model_id: contextvars.ContextVar[str] = contextvars.ContextVar(
+    "rt_serve_multiplexed_model_id", default="")
 
 
 class Request:
@@ -59,9 +65,11 @@ class Replica:
         readiness barrier before a replica enters the routing table."""
         return self.replica_id
 
-    async def handle_request(self, method_name: str, args: tuple, kwargs: dict):
+    async def handle_request(self, method_name: str, args: tuple, kwargs: dict,
+                             multiplexed_model_id: str = ""):
         self.ongoing += 1
         self.total += 1
+        token = _multiplexed_model_id.set(multiplexed_model_id)
         try:
             # Calling the instance itself covers both function deployments
             # and class deployments' __call__.
@@ -75,14 +83,19 @@ class Replica:
             else:
                 # SYNC user code must not block the replica's event loop —
                 # it would serialize all in-flight requests and hide the
-                # real ongoing count from the autoscaler/router.
+                # real ongoing count from the autoscaler/router. Context is
+                # copied explicitly: run_in_executor does not propagate
+                # contextvars (the multiplexed model id) on its own.
                 loop = asyncio.get_event_loop()
+                ctx = contextvars.copy_context()
                 out = await loop.run_in_executor(
-                    None, lambda: target(*args, **(kwargs or {})))
+                    None, lambda: ctx.run(
+                        lambda: target(*args, **(kwargs or {}))))
             if inspect.isawaitable(out):
                 out = await out
             return out
         finally:
+            _multiplexed_model_id.reset(token)
             self.ongoing -= 1
 
     def stats(self) -> dict:

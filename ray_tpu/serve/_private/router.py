@@ -129,6 +129,10 @@ class Router:
         self._have_replicas = threading.Event()
         self._outstanding: dict[str, int] = {}
         self._tracked: dict = {}  # result ref -> replica id
+        # model id -> replica ids this router sent that model to (cache
+        # locality for multiplexed deployments; router-local knowledge —
+        # a wrong guess only costs the replica a model reload).
+        self._model_replicas: dict[str, list] = {}
         self._lock = threading.Lock()
         self._closed = threading.Event()
         threading.Thread(target=self._longpoll_loop, daemon=True,
@@ -151,6 +155,9 @@ class Router:
                     self._outstanding = {
                         rid: n for rid, n in self._outstanding.items()
                         if rid in live}
+                    self._model_replicas = {
+                        m: [r for r in rids if r in live]
+                        for m, rids in self._model_replicas.items()}
                 if self._replicas:
                     self._have_replicas.set()
                 else:
@@ -186,9 +193,11 @@ class Router:
 
     # --------------------------------------------------------------- assign
     def assign(self, method_name: str, args: tuple, kwargs: dict,
-               timeout: float = 30.0):
-        """Pick a replica (pow-2 choices) and dispatch; returns the result
-        ObjectRef."""
+               timeout: float = 30.0, multiplexed_model_id: str = ""):
+        """Pick a replica and dispatch; returns the result ObjectRef.
+        Multiplexed requests prefer replicas this router already routed the
+        model to (reference multiplex cache locality), then fall back to
+        pow-2-choices balancing."""
         deadline = time.monotonic() + timeout
         while True:
             left = deadline - time.monotonic()
@@ -197,6 +206,20 @@ class Router:
                     f"no ready replicas for deployment {self.deployment!r}")
             with self._lock:
                 reps = self._replicas
+                if multiplexed_model_id and reps:
+                    known = self._model_replicas.get(multiplexed_model_id, ())
+                    hot = [(r, h) for r, h in reps if r in known]
+                    if hot:
+                        # Spill to cold replicas when every hot one is
+                        # clearly busier than the least-loaded replica —
+                        # a popular model must not be capped at one
+                        # replica's throughput.
+                        floor = min(self._outstanding.get(r, 0)
+                                    for r, _h in reps)
+                        hot_floor = min(self._outstanding.get(r, 0)
+                                        for r, _h in hot)
+                        if hot_floor - floor <= 2:
+                            reps = hot
                 if not reps:
                     pass  # emptied between the event wait and the lock
                 elif len(reps) == 1:
@@ -212,7 +235,18 @@ class Router:
             time.sleep(0.02)  # rare: replica set emptied mid-assign
         with self._lock:
             self._outstanding[rid] = self._outstanding.get(rid, 0) + 1
-        ref = handle.handle_request.remote(method_name, args, kwargs)
+            if multiplexed_model_id:
+                lst = self._model_replicas.setdefault(multiplexed_model_id, [])
+                if rid not in lst:
+                    lst.append(rid)
+                # Bound the map: ids are client-supplied (HTTP header) and
+                # must not leak memory in a long-running proxy.
+                while len(self._model_replicas) > 512:
+                    self._model_replicas.pop(
+                        next(iter(self._model_replicas)))
+        ref = handle.handle_request.remote(
+            method_name, args, kwargs,
+            multiplexed_model_id=multiplexed_model_id)
         with self._lock:
             self._tracked[ref] = rid
         return ref
@@ -227,11 +261,13 @@ class DeploymentResponse:
     request; .result() retries once on replica death (the router has
     already learned about the dead replica via long-poll by then)."""
 
-    def __init__(self, router: Router, method_name: str, args, kwargs, ref):
+    def __init__(self, router: Router, method_name: str, args, kwargs, ref,
+                 multiplexed_model_id: str = ""):
         self._router = router
         self._method = method_name
         self._args, self._kwargs = args, kwargs
         self._ref = ref
+        self._model_id = multiplexed_model_id
 
     def result(self, timeout_s: float = 60.0):
         from ray_tpu.exceptions import ActorDiedError, WorkerCrashedError
@@ -240,8 +276,9 @@ class DeploymentResponse:
             return ray_tpu.get(self._ref, timeout=timeout_s)
         except (ActorDiedError, WorkerCrashedError):
             # replica died mid-request: route to a survivor once
-            self._ref = self._router.assign(self._method, self._args,
-                                            self._kwargs)
+            self._ref = self._router.assign(
+                self._method, self._args, self._kwargs,
+                multiplexed_model_id=self._model_id)
             return ray_tpu.get(self._ref, timeout=timeout_s)
 
     def __await__(self):
@@ -260,8 +297,9 @@ class DeploymentResponse:
         try:
             return await resolver.submit(self._ref)
         except (ActorDiedError, WorkerCrashedError):
-            self._ref = self._router.assign(self._method, self._args,
-                                            self._kwargs)
+            self._ref = self._router.assign(
+                self._method, self._args, self._kwargs,
+                multiplexed_model_id=self._model_id)
             return await resolver.submit(self._ref)
 
     def _to_object_ref(self):
@@ -276,32 +314,43 @@ class DeploymentHandle:
 
     def __init__(self, deployment: str,
                  controller_name: str = "_serve_controller",
-                 method_name: str = "__call__"):
+                 method_name: str = "__call__",
+                 multiplexed_model_id: str = ""):
         self.deployment = deployment
         self.controller_name = controller_name
         self.method_name = method_name
+        self.multiplexed_model_id = multiplexed_model_id
 
     @property
     def _router(self) -> Router:
         return get_router(self.controller_name, self.deployment)
 
-    def options(self, method_name: str) -> "DeploymentHandle":
-        return DeploymentHandle(self.deployment, self.controller_name,
-                                method_name)
+    def options(self, method_name: Optional[str] = None,
+                multiplexed_model_id: Optional[str] = None) -> "DeploymentHandle":
+        return DeploymentHandle(
+            self.deployment, self.controller_name,
+            method_name if method_name is not None else self.method_name,
+            multiplexed_model_id if multiplexed_model_id is not None
+            else self.multiplexed_model_id)
 
     def __getattr__(self, name: str) -> "DeploymentHandle":
         if name.startswith("_"):
             raise AttributeError(name)
-        return DeploymentHandle(self.deployment, self.controller_name, name)
+        return DeploymentHandle(self.deployment, self.controller_name, name,
+                                self.multiplexed_model_id)
 
     def remote(self, *args, **kwargs) -> DeploymentResponse:
-        ref = self._router.assign(self.method_name, args, kwargs)
+        ref = self._router.assign(
+            self.method_name, args, kwargs,
+            multiplexed_model_id=self.multiplexed_model_id)
         return DeploymentResponse(self._router, self.method_name, args,
-                                  kwargs, ref)
+                                  kwargs, ref,
+                                  multiplexed_model_id=self.multiplexed_model_id)
 
     def __reduce__(self):
         return (DeploymentHandle,
-                (self.deployment, self.controller_name, self.method_name))
+                (self.deployment, self.controller_name, self.method_name,
+                 self.multiplexed_model_id))
 
     def __repr__(self):
         return f"DeploymentHandle({self.deployment!r})"

@@ -196,11 +196,13 @@ class MedianStoppingRule(FIFOScheduler):
         self._scores.setdefault(trial.trial_id, []).append(score)
         if t < self.grace_period:
             return CONTINUE
-        # Compare against other trials' running averages UP TO this step —
-        # all-time averages would judge late starters against finished
-        # trials' full runs (reference computes the median of running
-        # averages at the same time step).
-        upto = max(1, int(t))
+        # Compare against other trials' running averages truncated to the
+        # same REPORT COUNT as this trial — all-time averages would judge
+        # late starters against finished trials' full runs, and slicing by
+        # the raw time_attr value breaks for non-unit attrs like
+        # timesteps_total (reference: median of running averages at the
+        # same time step).
+        upto = len(self._scores[trial.trial_id])
         others = [vals[:upto] for tid, vals in self._scores.items()
                   if tid != trial.trial_id and vals]
         if len(others) < self.min_samples_required:
@@ -247,18 +249,22 @@ class HyperBandScheduler(FIFOScheduler):
         if score is None:
             return CONTINUE
         seen = trial.sched_state.setdefault("hb_milestones", set())
-        decision = CONTINUE
         for m in self.milestones:
-            if t < m:
-                continue
-            if m not in seen:
+            if t >= m and m not in seen:
                 seen.add(m)
                 self._recorded[m].append(score)
                 self._at[m][trial.trial_id] = score
-            rec = self._recorded[m]
-            if len(rec) >= self.rf:
-                keep = max(1, int(len(rec) / self.rf))
-                cutoff = sorted(rec, reverse=True)[keep - 1]
-                if self._at[m][trial.trial_id] < cutoff:
-                    decision = STOP
-        return decision
+        # Judge ONLY at the highest crossed milestone: a stale low-rung
+        # cutoff must not retroactively kill a trial that already survived
+        # (and improved past) higher rungs.
+        crossed = [m for m in self.milestones if t >= m]
+        if not crossed:
+            return CONTINUE
+        m = crossed[-1]
+        rec = self._recorded[m]
+        if len(rec) >= self.rf:
+            keep = max(1, int(len(rec) / self.rf))
+            cutoff = sorted(rec, reverse=True)[keep - 1]
+            if self._at[m][trial.trial_id] < cutoff:
+                return STOP
+        return CONTINUE

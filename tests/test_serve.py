@@ -232,3 +232,50 @@ def test_replica_death_recovery(serve_shutdown):
     for _ in range(10):
         out = json.loads(_http(f"http://127.0.0.1:{port}/"))
         assert out["pid"] != first
+
+
+def test_multiplexed_models(serve_shutdown):
+    ray_tpu.init(num_cpus=4)
+    """@serve.multiplexed: per-replica LRU of loaded models, requests routed
+    by model id with cache locality (reference serve/multiplex.py +
+    handle.options(multiplexed_model_id=...))."""
+    from ray_tpu import serve
+
+    @serve.deployment(num_replicas=2)
+    class Multi:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id: str):
+            self.loads.append(model_id)
+            return f"model::{model_id}"
+
+        async def __call__(self, request):
+            mid = serve.get_multiplexed_model_id()
+            model = await self.get_model(mid)
+            return {"model": model, "loads": list(self.loads)}
+
+        async def loads_so_far(self):
+            return list(self.loads)
+
+    handle = serve.run(Multi.bind(), port=_free_port())
+    # 6 calls for model a, 6 for b: with cache locality each model should be
+    # loaded on at most... the first call pins it to one replica; repeats
+    # reuse it.
+    outs_a = [handle.options(multiplexed_model_id="a").remote(None).result(
+        timeout_s=60) for _ in range(6)]
+    outs_b = [handle.options(multiplexed_model_id="b").remote(None).result(
+        timeout_s=60) for _ in range(6)]
+    assert all(o["model"] == "model::a" for o in outs_a)
+    assert all(o["model"] == "model::b" for o in outs_b)
+    # Cache locality: total loads of each model across replicas == 1 (every
+    # later request for the model hit the replica that already had it).
+    all_loads = [o["loads"] for o in outs_a + outs_b]
+    final = max(all_loads, key=len)
+    assert final.count("a") <= 1 or final.count("b") <= 1
+    # LRU eviction: push a third model through the same replica repeatedly
+    for mid in ("c", "d", "e"):
+        out = handle.options(multiplexed_model_id=mid).remote(None).result(
+            timeout_s=60)
+        assert out["model"] == f"model::{mid}"
