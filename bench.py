@@ -274,6 +274,30 @@ def main():
     except Exception as e:
         log(f"  flash attention skipped: {e}")
 
+    # ---- LLM KV-cache decode throughput (single chip) --------------------
+    try:
+        import jax
+
+        if jax.devices()[0].platform == "tpu":
+            from ray_tpu.llm import LLMConfig, LLMEngine
+
+            lcfg = LLMConfig(vocab_size=32000, d_model=1024, n_layers=8,
+                             n_heads=16, max_seq=1024, max_new_tokens=128)
+            eng = LLMEngine(lcfg)
+            prompts = np.random.randint(0, 32000, size=(8, 128))
+            # Warm with the SAME step count: the decode scan is compiled
+            # per n_steps, and a recompile must not land in the timed run.
+            eng.generate(prompts, max_new_tokens=128)
+            t0 = time.perf_counter()
+            out = eng.generate(prompts, max_new_tokens=128)
+            dt = time.perf_counter() - t0
+            tps = 8 * 128 / dt
+            results["llm_decode_tokens_per_s"] = tps
+            log(f"  llm decode: {tps:,.0f} tok/s "
+                f"(kv-cache, b8, 1024d x 8L, prefill 128 + 128 new)")
+    except Exception as e:
+        log(f"  llm decode skipped: {e}")
+
     # ---- RLlib PPO env-steps/sec (BASELINE north-star workload) ----------
     try:
         from ray_tpu.rllib import PPOConfig

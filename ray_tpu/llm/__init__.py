@@ -34,7 +34,10 @@ class LLMConfig:
 
 class LLMEngine:
     """Greedy-decoding engine over the flagship Transformer (the seat the
-    reference gives vLLM)."""
+    reference gives vLLM). KV-cache decode: prefill fills per-layer caches
+    in one pass, then every generated token is ONE fixed-shape compiled
+    step attending over the cache — O(S) per token instead of the naive
+    O(S^2) re-forward of the growing context."""
 
     def __init__(self, cfg: LLMConfig):
         import jax
@@ -55,21 +58,61 @@ class LLMEngine:
             dummy = jnp.zeros((1, 8), jnp.int32)
             self.params = self.model.init(
                 jax.random.PRNGKey(cfg.seed), dummy)
-        self._step = jax.jit(
-            lambda p, toks: jnp.argmax(
-                self.model.apply(p, toks)[:, -1, :], axis=-1))
+
+        def _prefill(params, toks):
+            """Full-prompt pass that also fills the KV caches."""
+            b, s = toks.shape
+            positions = jnp.broadcast_to(jnp.arange(s), (b, s))
+            logits, vars_out = self.model.apply(
+                params, toks, positions=positions, decode=True,
+                mutable=["cache"])
+            return jnp.argmax(logits[:, -1, :], axis=-1), vars_out["cache"]
+
+        def _decode(params, cache, first_tok, start_pos, n_steps):
+            """n_steps single-token cached steps under ONE lax.scan."""
+            def step(carry, _):
+                cache, tok, pos = carry
+                logits, vars_out = self.model.apply(
+                    {**params, "cache": cache}, tok[:, None],
+                    positions=pos[:, None], decode=True, mutable=["cache"])
+                nxt = jnp.argmax(logits[:, -1, :], axis=-1)
+                return (vars_out["cache"], nxt, pos + 1), tok
+
+            # length=n_steps-1: the scan COLLECTS the carried-in token each
+            # step, so [first, g2..g_{n-1}] plus the final carry `last`
+            # covers all n tokens without a wasted trailing forward pass.
+            (cache, last, _), toks = jax.lax.scan(
+                step, (cache, first_tok, start_pos), None,
+                length=n_steps - 1)
+            return jnp.moveaxis(toks, 0, 1), last  # [B, n_steps-1], [B]
+
+        self._prefill = jax.jit(_prefill)
+        self._decode = jax.jit(_decode, static_argnums=4)
 
     def generate(self, prompts: np.ndarray,
                  max_new_tokens: Optional[int] = None) -> np.ndarray:
-        """prompts: [B, S] int32 -> [B, S + new] (greedy)."""
+        """prompts: [B, S] int32 -> [B, S + new] (greedy, KV-cached)."""
         import jax.numpy as jnp
 
         toks = jnp.asarray(prompts, jnp.int32)
+        b, s = toks.shape
         n = max_new_tokens or self.cfg.max_new_tokens
-        for _ in range(n):
-            nxt = self._step(self.params, toks)
-            toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
-        return np.asarray(toks)
+        if s + n > self.cfg.max_seq:
+            # The KV cache is a fixed [B, max_seq] buffer; requests past it
+            # must fail loudly, not silently return fewer tokens.
+            raise ValueError(
+                f"prompt ({s}) + max_new_tokens ({n}) exceeds the engine's "
+                f"max_seq ({self.cfg.max_seq})")
+        first, cache = self._prefill({"params": self.params["params"]}, toks)
+        start_pos = jnp.full((b,), s, jnp.int32)
+        if n == 1:
+            return np.asarray(jnp.concatenate([toks, first[:, None]], axis=1))
+        gen, last = self._decode({"params": self.params["params"]}, cache,
+                                 first, start_pos, n)
+        # gen = [first, g2..g_{n-1}] (the scan collects carried-in tokens);
+        # `last` completes the n generated tokens.
+        out = jnp.concatenate([toks, gen, last[:, None]], axis=1)
+        return np.asarray(out)
 
 
 class LLMPredictor:

@@ -73,7 +73,7 @@ class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, positions):
+    def __call__(self, x, positions, decode: bool = False):
         cfg = self.cfg
         hd = cfg.head_dim
         dense = lambda feats, name: nn.DenseGeneral(  # noqa: E731
@@ -84,9 +84,49 @@ class Attention(nn.Module):
         v = dense((cfg.n_kv_heads, hd), "wv")(x)
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
-        out = dot_product_attention(q, k, v, causal=True)
+        if decode:
+            out = self._cached_attention(q, k, v)
+        else:
+            out = dot_product_attention(q, k, v, causal=True)
         return nn.DenseGeneral(cfg.d_model, axis=(-2, -1), use_bias=False, name="wo",
                                dtype=cfg.dtype, param_dtype=cfg.param_dtype)(out)
+
+    def _cached_attention(self, q, k, v):
+        """Autoregressive KV-cache attention (the flax decode-cache pattern,
+        reference role: vLLM's paged KV cache): new k/v land in fixed
+        [B, max_seq, KV, D] buffers at the current index; queries attend
+        over everything cached so far. Fixed shapes keep every decode step
+        the same compiled program — no recompiles, no growing context
+        re-forward (the O(S^2)-per-token cost the naive path pays)."""
+        cfg = self.cfg
+        b, s = q.shape[0], q.shape[1]
+        ck = self.variable("cache", "k", lambda: jnp.zeros(
+            (b, cfg.max_seq, cfg.n_kv_heads, cfg.head_dim), cfg.dtype))
+        cv = self.variable("cache", "v", lambda: jnp.zeros(
+            (b, cfg.max_seq, cfg.n_kv_heads, cfg.head_dim), cfg.dtype))
+        cidx = self.variable("cache", "idx",
+                             lambda: jnp.zeros((), jnp.int32))
+        cur = cidx.value
+        ck.value = jax.lax.dynamic_update_slice(
+            ck.value, k.astype(cfg.dtype), (0, cur, 0, 0))
+        cv.value = jax.lax.dynamic_update_slice(
+            cv.value, v.astype(cfg.dtype), (0, cur, 0, 0))
+        cidx.value = cur + s
+        keys, vals = ck.value, cv.value
+        if cfg.n_kv_heads < cfg.n_heads:  # GQA: broadcast kv heads
+            rep = cfg.n_heads // cfg.n_kv_heads
+            keys = jnp.repeat(keys, rep, axis=2)
+            vals = jnp.repeat(vals, rep, axis=2)
+        scores = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32),
+                            keys.astype(jnp.float32)) / (cfg.head_dim ** 0.5)
+        # position t is visible to query i iff t <= cur + i
+        t_pos = jnp.arange(cfg.max_seq)[None, None, None, :]
+        q_pos = (cur + jnp.arange(s))[None, None, :, None]
+        scores = jnp.where(t_pos <= q_pos, scores, -jnp.inf)
+        probs = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhst,bthd->bshd", probs,
+                         vals.astype(jnp.float32))
+        return out.astype(cfg.dtype)
 
 
 class SwiGLU(nn.Module):
@@ -146,8 +186,9 @@ class Block(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, positions):
-        x = x + Attention(self.cfg, name="attn")(RMSNorm(name="attn_norm")(x), positions)
+    def __call__(self, x, positions, decode: bool = False):
+        x = x + Attention(self.cfg, name="attn")(
+            RMSNorm(name="attn_norm")(x), positions, decode=decode)
         mlp = (MoE(self.cfg, name="moe") if self.cfg.moe_experts
                else SwiGLU(self.cfg, name="mlp"))
         x = x + mlp(RMSNorm(name="mlp_norm")(x))
@@ -158,16 +199,21 @@ class Transformer(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens):
-        """tokens: [B, S] int32 -> logits [B, S, vocab] (f32)."""
+    def __call__(self, tokens, positions=None, decode: bool = False):
+        """tokens: [B, S] int32 -> logits [B, S, vocab] (f32).
+
+        decode=True uses per-layer KV caches (flax "cache" collection):
+        pass `positions` (absolute) and apply with mutable=["cache"]."""
         cfg = self.cfg
         emb = self.param("tok_emb", nn.initializers.normal(0.02),
                          (cfg.vocab_size, cfg.d_model), cfg.param_dtype)
         x = emb[tokens].astype(cfg.dtype)
-        positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(tokens.shape[1]), tokens.shape)
         for i in range(cfg.n_layers):
-            x = _seq_shard(x)
-            x = Block(cfg, name=f"layer_{i}")(x, positions)
+            if not decode:
+                x = _seq_shard(x)
+            x = Block(cfg, name=f"layer_{i}")(x, positions, decode=decode)
         x = RMSNorm(name="final_norm")(x)
         # Tied output head (vocab-sharded matmul over tp).
         return jnp.einsum("bsd,vd->bsv", x, emb.astype(cfg.dtype)).astype(jnp.float32)

@@ -131,3 +131,33 @@ def test_llm_batch_inference_and_serve(ray_start_4cpu):
         assert len(rep["generated"][0]) == 11
     finally:
         serve.shutdown()
+
+
+def test_kv_cache_decode_matches_naive():
+    """KV-cached greedy decode must produce EXACTLY the tokens the naive
+    re-forward-the-context decode produces (the cache is an optimization,
+    not a semantics change)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.llm import LLMConfig, LLMEngine
+
+    cfg = LLMConfig(vocab_size=128, d_model=64, n_layers=2, n_heads=4,
+                    max_seq=64, max_new_tokens=12, seed=3)
+    eng = LLMEngine(cfg)
+
+    rng = np.random.default_rng(0)
+    prompts = rng.integers(0, cfg.vocab_size, size=(2, 9), dtype=np.int64)
+
+    out = eng.generate(prompts)
+    assert out.shape == (2, 9 + 12)
+    assert np.array_equal(out[:, :9], prompts)
+
+    # Naive reference: re-forward the growing context each step.
+    toks = jnp.asarray(prompts, jnp.int32)
+    for _ in range(12):
+        logits = eng.model.apply(eng.params, toks)
+        nxt = jnp.argmax(logits[:, -1, :], axis=-1)
+        toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+    assert np.array_equal(out, np.asarray(toks)), (out, np.asarray(toks))
