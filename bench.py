@@ -282,7 +282,8 @@ def main():
             from ray_tpu.llm import LLMConfig, LLMEngine
 
             lcfg = LLMConfig(vocab_size=32000, d_model=1024, n_layers=8,
-                             n_heads=16, max_seq=1024, max_new_tokens=128)
+                             n_heads=16, max_seq=1024, max_new_tokens=128,
+                             dtype="bfloat16")
             eng = LLMEngine(lcfg)
             prompts = np.random.randint(0, 32000, size=(8, 128))
             # Warm with the SAME step count: the decode scan is compiled
@@ -294,7 +295,7 @@ def main():
             tps = 8 * 128 / dt
             results["llm_decode_tokens_per_s"] = tps
             log(f"  llm decode: {tps:,.0f} tok/s "
-                f"(kv-cache, b8, 1024d x 8L, prefill 128 + 128 new)")
+                f"(bf16 kv-cache, b8, 1024d x 8L, prefill 128 + 128 new)")
     except Exception as e:
         log(f"  llm decode skipped: {e}")
 

@@ -7,4 +7,19 @@ to Pallas TPU kernels (ops/flash_attention.py) without touching model code.
 
 from ray_tpu.ops.attention import dot_product_attention
 
-__all__ = ["dot_product_attention"]
+
+def ring_attention(*args, **kwargs):
+    """Lazy alias for ray_tpu.ops.ring_attention.ring_attention."""
+    from ray_tpu.ops.ring_attention import ring_attention as _ra
+
+    return _ra(*args, **kwargs)
+
+
+def ulysses_attention(*args, **kwargs):
+    """Lazy alias for ray_tpu.ops.ulysses.ulysses_attention."""
+    from ray_tpu.ops.ulysses import ulysses_attention as _ua
+
+    return _ua(*args, **kwargs)
+
+
+__all__ = ["dot_product_attention", "ring_attention", "ulysses_attention"]

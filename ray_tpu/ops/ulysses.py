@@ -1,0 +1,58 @@
+"""Ulysses (DeepSpeed-style) sequence parallelism: all-to-all head sharding.
+
+The second long-context strategy alongside ring attention (SURVEY §2.4 —
+the reference has neither; both are designed fresh here). Where ring
+attention keeps the sequence sharded and rotates k/v around the mesh axis,
+Ulysses RESHARDS for the attention op itself:
+
+    in:  q/k/v sharded over sequence  [B, S/n, H, D]  (activations layout)
+    all_to_all -> sharded over heads  [B, S, H/n, D]  (each device sees the
+                                                       FULL sequence for a
+                                                       1/n slice of heads)
+    local attention (flash kernel / XLA — no cross-device math)
+    all_to_all back -> sequence-sharded output [B, S/n, H, D]
+
+Two all-to-alls of the activations per attention call, each moving
+O(B.S.H.D / n) bytes per device over ICI — cheaper than ring's n-step
+k/v rotation when heads divide evenly and S is large, but it caps the
+sequence-parallel degree at the head count (ring has no such cap). Use
+inside shard_map over the 'sp' (or any) mesh axis.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from ray_tpu.ops import dot_product_attention
+
+
+def ulysses_attention(q, k, v, *, axis_name: str, causal: bool = True):
+    """q: [B, S_local, H, D] sequence-sharded over `axis_name`; k/v the
+    same layout (kv heads must also divide the axis size). Returns the
+    sequence-sharded output [B, S_local, H, D]."""
+    n = jax.lax.psum(1, axis_name)
+    hq = q.shape[2]
+    hkv = k.shape[2]
+    if hq % n or hkv % n:
+        raise ValueError(
+            f"ulysses needs head counts divisible by the axis size "
+            f"(q heads {hq}, kv heads {hkv}, axis {n})")
+
+    def seq_to_heads(x):
+        # [B, S/n, H, D] -> [B, S, H/n, D]: split the head axis n ways,
+        # all-to-all trades the sequence-shard axis for the head-shard
+        # axis, then the gathered sequence chunks concatenate.
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    def heads_to_seq(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    qh = seq_to_heads(q)
+    kh = seq_to_heads(k)
+    vh = seq_to_heads(v)
+    # Full-sequence attention over this device's head slice; causality is
+    # exact because every device sees ALL positions.
+    out = dot_product_attention(qh, kh, vh, causal=causal)
+    return heads_to_seq(out)
