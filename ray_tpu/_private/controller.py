@@ -335,6 +335,8 @@ class Controller:
         node = self.nodes.get(a["node_id"])
         if node is not None:
             node.last_beat = time.monotonic()
+            if "shm_used" in a:
+                node.shm_used = a["shm_used"]
 
     # ---------------------------------------------------------- scheduling
     def _kick(self):
@@ -1727,6 +1729,18 @@ class Controller:
                 for raw in pg.get("bundles_raw", []):
                     pg_demands.append({k: v / unit for k, v in raw.items()})
         return {"demand": demands, "pg_demand": pg_demands}
+
+    async def _h_object_store_stats(self, conn, a):
+        """Cluster shm usage (backs the Data executor's resource-based
+        backpressure; reference streaming_executor_state's
+        object-store-memory policy). Usage comes from node-agent heartbeats
+        — the stores' own accounting — NOT the object directory, whose
+        entries stay 'live' after a block spills to disk (directory-based
+        counting latched backpressure on permanently)."""
+        shm = sum(n.shm_used for n in self.nodes.values() if n.alive)
+        n_nodes = max(1, sum(1 for n in self.nodes.values() if n.alive))
+        return {"shm_bytes": shm,
+                "capacity": n_nodes * CONFIG.object_store_memory_bytes}
 
     async def _h_cluster_resources(self, conn, a):
         total: dict[str, float] = {}

@@ -246,7 +246,9 @@ class NodeAgent:
         while True:
             await asyncio.sleep(CONFIG.heartbeat_interval_s)
             try:
-                await self.controller.push("heartbeat", node_id=self.node_id)
+                await self.controller.push(
+                    "heartbeat", node_id=self.node_id,
+                    shm_used=self.store.shm_dir_usage())
             except Exception:
                 return
 

@@ -507,6 +507,25 @@ class LocalStore:
     def used_bytes(self) -> int:
         return self._used
 
+    def shm_dir_usage(self) -> int:
+        """Ground-truth bytes of this session's segments in the shm dir —
+        unlike _used, counts worker-produced segments their creator already
+        detached (the node agent reports this in heartbeats for the
+        cluster's backpressure accounting)."""
+        prefix = f"rt_{self.session}_"
+        total = 0
+        try:
+            with os.scandir(self.shm_dir) as it:
+                for e in it:
+                    if e.name.startswith(prefix):
+                        try:
+                            total += e.stat().st_size
+                        except OSError:
+                            pass
+        except OSError:
+            pass
+        return total
+
     def num_objects(self) -> int:
         return len(self._objects)
 

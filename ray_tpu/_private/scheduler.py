@@ -19,7 +19,7 @@ from ray_tpu._private.task_spec import SchedulingStrategy
 
 class NodeState:
     __slots__ = ("node_id", "address", "total", "available", "alive", "last_beat",
-                 "labels", "draining")
+                 "labels", "draining", "shm_used")
 
     def __init__(self, node_id: str, address: tuple, total: ResourceSet, labels: dict | None = None):
         self.node_id = node_id
@@ -32,6 +32,8 @@ class NodeState:
         # Draining (autoscaler scale-down handshake): schedulable = False.
         # The node keeps running what it has; nothing new lands on it.
         self.draining = False
+        # Heartbeat-reported shm-resident bytes (spilled blocks excluded).
+        self.shm_used = 0
 
     def utilization(self) -> float:
         scores = []

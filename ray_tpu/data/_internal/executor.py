@@ -16,14 +16,43 @@ AllToAllOperator/exchange.
 from __future__ import annotations
 
 import random
+import time
 from typing import Any, Callable, Optional
 
 import ray_tpu
 from ray_tpu.data.block import BlockAccessor, combine_blocks
 
-# Bounded concurrent block tasks (reference backpressure_policy/:
-# concurrency caps instead of resource-based policies in v0).
+# Bounded concurrent block tasks + a resource-based brake (reference
+# backpressure_policy/: ConcurrencyCapBackpressurePolicy and the
+# object-store-memory policy in streaming_executor_state).
 MAX_IN_FLIGHT = 16
+#: Pause new block submissions while cluster shm usage is above this
+#: fraction of capacity (consumers/spill catch up; submissions resume).
+STORE_BACKPRESSURE_FRACTION = 0.75
+_BP_POLL_S = 0.2
+
+_bp_cache = {"t": 0.0, "hit": False}
+
+
+def _store_backpressured() -> bool:
+    """Cluster object-store usage above the high-water mark? Cached for
+    _BP_POLL_S so the hot submit loop costs one controller round trip per
+    poll interval, not per block."""
+    now = time.monotonic()
+    if now - _bp_cache["t"] < _BP_POLL_S:
+        return _bp_cache["hit"]
+    _bp_cache["t"] = now
+    try:
+        from ray_tpu._private.worker import global_worker
+
+        w = global_worker()
+        rep = w.io.run(w.controller.call("object_store_stats"), timeout=10)
+        cap = rep.get("capacity") or 1
+        _bp_cache["hit"] = rep.get("shm_bytes", 0) > \
+            STORE_BACKPRESSURE_FRACTION * cap
+    except Exception:
+        _bp_cache["hit"] = False
+    return _bp_cache["hit"]
 
 
 # ------------------------------------------------------------ logical plan
@@ -247,7 +276,10 @@ def _windowed_submit(items: list, submit) -> list:
     in_flight: dict = {}
     i = 0
     while i < len(items) or in_flight:
-        while i < len(items) and len(in_flight) < MAX_IN_FLIGHT:
+        while (i < len(items) and len(in_flight) < MAX_IN_FLIGHT
+               and not (in_flight and _store_backpressured())):
+            # The brake only engages with work already in flight: progress
+            # is always possible even when the store starts above the mark.
             out[i] = submit(items[i])
             in_flight[out[i]] = i
             i += 1

@@ -7,19 +7,19 @@ to Pallas TPU kernels (ops/flash_attention.py) without touching model code.
 
 from ray_tpu.ops.attention import dot_product_attention
 
-
-def ring_attention(*args, **kwargs):
-    """Lazy alias for ray_tpu.ops.ring_attention.ring_attention."""
-    from ray_tpu.ops.ring_attention import ring_attention as _ra
-
-    return _ra(*args, **kwargs)
-
-
-def ulysses_attention(*args, **kwargs):
-    """Lazy alias for ray_tpu.ops.ulysses.ulysses_attention."""
-    from ray_tpu.ops.ulysses import ulysses_attention as _ua
-
-    return _ua(*args, **kwargs)
-
-
 __all__ = ["dot_product_attention", "ring_attention", "ulysses_attention"]
+
+
+def __getattr__(name):
+    # PEP 562 lazy exports. A def-style alias named `ring_attention` would
+    # be CLOBBERED the first time the ray_tpu.ops.ring_attention submodule
+    # imports (importlib setattrs the module object onto the package).
+    if name == "ring_attention":
+        from ray_tpu.ops.ring_attention import ring_attention as fn
+
+        return fn
+    if name == "ulysses_attention":
+        from ray_tpu.ops.ulysses import ulysses_attention as fn
+
+        return fn
+    raise AttributeError(name)
