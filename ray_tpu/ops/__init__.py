@@ -11,15 +11,17 @@ __all__ = ["dot_product_attention", "ring_attention", "ulysses_attention"]
 
 
 def __getattr__(name):
-    # PEP 562 lazy exports. A def-style alias named `ring_attention` would
-    # be CLOBBERED the first time the ray_tpu.ops.ring_attention submodule
-    # imports (importlib setattrs the module object onto the package).
+    # PEP 562 lazy exports, PINNED into the package namespace on first
+    # access: importing the ray_tpu.ops.ring_attention submodule setattrs
+    # the module object onto the package, and without the pin a later
+    # attribute lookup would resolve to that module instead of the
+    # function (module __dict__ wins over __getattr__ only when the name
+    # is absent — so put the function there).
     if name == "ring_attention":
         from ray_tpu.ops.ring_attention import ring_attention as fn
-
-        return fn
-    if name == "ulysses_attention":
+    elif name == "ulysses_attention":
         from ray_tpu.ops.ulysses import ulysses_attention as fn
-
-        return fn
-    raise AttributeError(name)
+    else:
+        raise AttributeError(name)
+    globals()[name] = fn
+    return fn

@@ -60,6 +60,7 @@ class JaxTrainer:
         self._scaling = scaling_config or ScalingConfig()
         self._run_config = run_config or RunConfig()
         self._datasets = datasets
+        self._controller: TrainController | None = None
 
     def fit(self) -> Result:
         controller = TrainController(
@@ -69,6 +70,7 @@ class JaxTrainer:
             run_config=self._run_config,
             datasets=self._datasets,
         )
+        self._controller = controller
         return controller.run()
 
 

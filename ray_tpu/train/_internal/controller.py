@@ -58,10 +58,35 @@ class TrainController:
         self._checkpoint_paths: list[str] = []
         self.failures = 0
 
-    def _split_datasets(self) -> Optional[list]:
+    def _elastic_size(self) -> int:
+        """Workers for the NEXT attempt (reference train v2 ScalingPolicy's
+        elastic recovery decision): fixed groups always ask for num_workers;
+        elastic groups (min_workers set) size to what the cluster can place
+        right now, clamped to [min_workers, num_workers]."""
+        want = self.scaling.num_workers
+        lo = self.scaling.min_workers
+        if lo is None or lo >= want:
+            return want
+        try:
+            from ray_tpu._private.rtconfig import CONFIG
+
+            # Let failure detection settle: right after a node dies its
+            # resources still look available until the heartbeat timeout,
+            # and sizing against them would hang the restart on actors
+            # that can never place.
+            time.sleep(CONFIG.heartbeat_interval_s
+                       * CONFIG.num_heartbeats_timeout + 0.5)
+            avail = ray_tpu.available_resources()
+        except Exception:
+            return want
+        per = self.scaling.worker_resources()
+        fits = min((int(avail.get(k, 0.0) // v) for k, v in per.items() if v),
+                   default=want)
+        return max(1, lo, min(want, fits))
+
+    def _split_datasets(self, n: int) -> Optional[list]:
         if not self.datasets:
             return None
-        n = self.scaling.num_workers
         shards = [dict() for _ in range(n)]
         for name, ds in self.datasets.items():
             if hasattr(ds, "streaming_split"):
@@ -76,16 +101,21 @@ class TrainController:
         max_failures = self.run_config.failure_config.max_failures
         attempt = 0
         while True:
+            n_workers = (self.scaling.num_workers if attempt == 0
+                         else self._elastic_size())
+            if attempt > 0 and n_workers != self.scaling.num_workers:
+                logger.warning("elastic restart with %d/%d workers",
+                               n_workers, self.scaling.num_workers)
             try:
                 group = WorkerGroup(
-                    num_workers=self.scaling.num_workers,
+                    num_workers=n_workers,
                     resources_per_worker=self.scaling.worker_resources(),
                     run_name=self.run_name,
                     storage_dir=self.storage_dir,
                     group_name=f"train-{self.run_name}-r{attempt}",
                     restart_index=attempt,
                     latest_checkpoint=self.latest_checkpoint,
-                    dataset_shards_per_worker=self._split_datasets(),
+                    dataset_shards_per_worker=self._split_datasets(n_workers),
                     jax_distributed=self.scaling.jax_distributed,
                     worker_env=self.scaling.worker_env,
                 )

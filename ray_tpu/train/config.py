@@ -34,6 +34,12 @@ class ScalingConfig:
     #: the worker (e.g. XLA_FLAGS=--xla_force_host_platform_device_count=4
     #: to give each worker a virtual device mesh in tests).
     worker_env: Optional[dict] = None
+    #: Elastic lower bound (reference train v2 ScalingPolicy): on a group
+    #: failure the restart sizes itself to what the cluster can actually
+    #: place — min_workers..num_workers — instead of waiting forever for
+    #: the full quorum (training resumes from the checkpoint with data
+    #: re-split over the surviving workers). None = fixed-size restarts.
+    min_workers: Optional[int] = None
 
     def worker_resources(self) -> dict:
         if self.resources_per_worker is not None:

@@ -57,6 +57,10 @@ def mnist_train_loop(config):
         return jax.value_and_grad(lambda q: loss_fn(model, q, batch))(p)
 
     for step in range(start_step, config["steps"]):
+        if config.get("slow_step_s"):
+            import time as _t
+
+            _t.sleep(config["slow_step_s"])
         loss, grads = grad_step(params, (jnp.asarray(x), jnp.asarray(y)))
         grads = jax_utils.sync_gradients(grads)
         grads = jax.tree_util.tree_map(jnp.asarray, grads)
@@ -170,3 +174,45 @@ def test_jax_distributed_global_mesh(ray_start_4cpu, tmp_path):
     assert result.metrics["devices"] == 8
     assert result.metrics["procs"] == 2
     assert result.metrics["total"] == 32.0
+
+
+def test_elastic_recovery_on_node_loss(ray_start_cluster, tmp_path):
+    """A node dies mid-training and the cluster can no longer place the
+    full quorum: with min_workers set, the group restarts SMALLER from the
+    checkpoint and finishes (reference train v2 elastic ScalingPolicy),
+    instead of waiting forever for capacity that is gone."""
+    import threading
+    import time as _time
+
+    cluster = ray_start_cluster
+    side = cluster.add_node(num_cpus=2)
+    ray_tpu.init(address=cluster.address)
+
+    trainer = JaxTrainer(
+        mnist_train_loop,
+        train_loop_config={"batch": 32, "steps": 8, "slow_step_s": 0.4},
+        scaling_config=ScalingConfig(num_workers=3, min_workers=1),
+        run_config=RunConfig(name="elastic", storage_path=str(tmp_path),
+                             failure_config=FailureConfig(max_failures=3)),
+    )
+    box = {}
+
+    def _fit():
+        box["result"] = trainer.fit()
+
+    t = threading.Thread(target=_fit)
+    t.start()
+    # Let training make progress (and checkpoint), then yank the side node.
+    deadline = _time.monotonic() + 120
+    while _time.monotonic() < deadline and not (
+            trainer._controller and trainer._controller.metrics_history):
+        _time.sleep(0.2)
+    assert trainer._controller and trainer._controller.metrics_history, \
+        "training never reported"
+    cluster.remove_node(side)
+    t.join(timeout=300)
+    assert not t.is_alive(), "elastic restart did not complete"
+    result = box["result"]
+    assert result.error is None, result.error
+    steps = [m["step"] for m in result.metrics_history]
+    assert 7 in steps  # ran to completion after shrinking
