@@ -15,7 +15,13 @@ from typing import Any, Iterable, Sequence
 from ray_tpu import exceptions  # noqa: F401
 from ray_tpu._private.bootstrap import HeadNode
 from ray_tpu._private.rtconfig import CONFIG
-from ray_tpu._private.worker import ObjectRef, Worker, global_worker, set_global_worker
+from ray_tpu._private.worker import (
+    ObjectRef,
+    ObjectRefGenerator,
+    Worker,
+    global_worker,
+    set_global_worker,
+)
 from ray_tpu.actor import ActorClass, ActorHandle, get_actor, kill, method  # noqa: F401
 from ray_tpu.remote_function import RemoteFunction
 
@@ -177,13 +183,16 @@ def wait(
     return w.wait(list(refs), num_returns=num_returns, timeout=timeout)
 
 
-def cancel(ref: ObjectRef, *, force: bool = False):
+def cancel(ref, *, force: bool = False):
     """Cancel a queued or running task (reference ray.cancel,
     core_worker.proto:492 CancelTask). Non-force delivers KeyboardInterrupt
     to the executing worker and get() raises TaskCancelledError; force kills
     the worker process and get() raises WorkerCrashedError. Child tasks are
-    not cancelled recursively."""
+    not cancelled recursively. Accepts an ObjectRefGenerator to cancel a
+    streaming task mid-stream."""
     w = _require_worker()
+    if isinstance(ref, ObjectRefGenerator):
+        return w.cancel_task(ref.task_id, force)
     return w.cancel_task(ref.task_id(), force)
 
 
@@ -257,6 +266,7 @@ __all__ = [
     "kill",
     "get_actor",
     "ObjectRef",
+    "ObjectRefGenerator",
     "ActorHandle",
     "cluster_resources",
     "available_resources",

@@ -24,6 +24,7 @@ logger = logging.getLogger(__name__)
 _lock = threading.Lock()
 _lib = None
 _tried = False
+_build_started = False
 
 _SRC = os.path.join(os.path.dirname(os.path.abspath(__file__)), "ring.cc")
 
@@ -114,13 +115,19 @@ def get_lib_nowait():
     merely prefer native (e.g. the object store's copy under its lock) use
     this so the first big put never stalls the whole object plane behind a
     g++ invocation."""
+    global _build_started
     if _lib is not None or _tried:
         return _lib
     if not _lock.acquire(blocking=False):
         return None  # a build is in progress on another thread
     try:
-        if _lib is not None or _tried:
+        if _lib is not None or _tried or _build_started:
             return _lib
+        # Flag under the lock BEFORE spawning: _tried only flips once the
+        # build thread itself re-acquires the lock, so without this every
+        # caller winning the non-blocking acquire first would spawn another
+        # duplicate g++ build.
+        _build_started = True
         threading.Thread(target=get_lib, daemon=True,
                          name="rt-native-build").start()
         return None

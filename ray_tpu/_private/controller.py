@@ -1755,6 +1755,14 @@ class Controller:
         return {"total": total, "available": avail}
 
     async def _h_state_snapshot(self, conn, a):
+        # Job driver subprocesses consume no scheduler-visible resources, so
+        # a node hosting one looks fully idle; surface the count so the
+        # autoscaler never drains a node out from under a running driver.
+        jobs_per_node: dict = {}
+        for job in self.jobs.values():
+            if job["status"] in ("PENDING", "RUNNING"):
+                jn = job["node_id"]
+                jobs_per_node[jn] = jobs_per_node.get(jn, 0) + 1
         return {
             "nodes": {
                 nid: {
@@ -1763,6 +1771,7 @@ class Controller:
                     "total": n.total.to_dict(),
                     "available": n.available.to_dict(),
                     "labels": n.labels,
+                    "active_jobs": jobs_per_node.get(nid, 0),
                 }
                 for nid, n in self.nodes.items()
             },

@@ -232,6 +232,8 @@ class LeaseManager:
                 self._task_done(lease, item)
             lease.idle_since = time.monotonic()
             self._pump(lease.cls)
+        elif method == "gen_items":
+            self.w._on_gen_items(conn, a["items"])
 
     def _task_done(self, lease: _Lease, item: dict):
         spec = lease.inflight.pop(item["task_id"], None)
@@ -279,6 +281,8 @@ class LeaseManager:
     # ----------------------------------------------------------- failure
     def _on_worker_conn_close(self, conn):
         lease = self._by_conn.pop(conn, None)
+        if not self._shutdown:
+            self.w._gen_conn_lost(conn)
         if lease is not None and not self._shutdown:
             self._lease_failed(lease, release=False)
 

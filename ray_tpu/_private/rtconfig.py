@@ -60,6 +60,10 @@ _flag("memory_monitor_refresh_ms", int, 250)
 _flag("object_chunk_bytes", int, 16 * 1024 * 1024)
 _flag("pull_max_inflight_bytes", int, 512 * 1024 * 1024)
 _flag("max_pending_calls_default", int, -1)
+# Streaming generators: executor pauses once this many yielded items are
+# unacknowledged by the consumer (reference
+# _generator_backpressure_num_objects); <=0 disables backpressure.
+_flag("generator_backpressure_items", int, 64)
 _flag("log_to_driver", bool, True)
 # Fixed-point resource arithmetic granularity (reference fixed_point.h uses 1e-4).
 _flag("resource_unit", int, 10000)

@@ -15,6 +15,10 @@ NORMAL = "normal"
 ACTOR_CREATE = "actor_create"
 ACTOR_TASK = "actor_task"
 
+#: num_returns value for streaming-generator tasks (reference
+#: num_returns="streaming" -> ObjectRefGenerator).
+STREAMING = "streaming"
+
 
 @dataclass
 class SchedulingStrategy:
@@ -183,6 +187,15 @@ class TaskSpec:
         n = self.num_returns
         if n == 1:
             return [self.task_id + "00000000"]
+        if n == STREAMING:
+            # Streaming generator (reference core_worker.proto:478
+            # ReportGeneratorItemReturns): item oids use indices 0..k-1 as
+            # they are yielded; the single declared return is the COMPLETION
+            # sentinel at the reserved max index. It resolves to the item
+            # count on success (or the stream's error), so every existing
+            # submit/retry/cancel/failure path that touches "the task's
+            # return ids" drives the generator's end-of-stream for free.
+            return [self.task_id + "ffffffff"]
         tid = self.task_id
         return [tid + i.to_bytes(4, "little").hex() for i in range(n)]
 

@@ -37,7 +37,14 @@ from ray_tpu._private.serialization import (
     loads_oob,
     serialize,
 )
-from ray_tpu._private.task_spec import ACTOR_CREATE, ACTOR_TASK, NORMAL, SchedulingStrategy, TaskSpec
+from ray_tpu._private.task_spec import (
+    ACTOR_CREATE,
+    ACTOR_TASK,
+    NORMAL,
+    STREAMING,
+    SchedulingStrategy,
+    TaskSpec,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -205,6 +212,139 @@ class _Resolution:
                 self.event.clear()
 
 
+class _GenState:
+    """Owner-side state of one streaming-generator task (reference
+    TaskManager's ObjectRefStream, task_manager.h:175 area). Items arrive as
+    `gen_items` pushes on the same ordered connection as the final reply;
+    the completion sentinel's resolution (watching it drives finish())
+    carries the authoritative item count so a completion that overtakes
+    trailing items — or a retry re-reporting earlier indices — cannot
+    truncate or duplicate the stream."""
+
+    __slots__ = ("task_id", "cond", "queue", "produced", "consumed", "done",
+                 "total", "error", "conn", "ack_stride")
+
+    def __init__(self, task_id: str, ack_stride: int):
+        self.task_id = task_id
+        self.cond = threading.Condition()
+        self.queue: deque = deque()  # oids ready to consume
+        self.produced = 0  # next expected item index
+        self.consumed = 0
+        self.done = False
+        self.total: int | None = None  # authoritative count, once known
+        self.error = None
+        self.conn = None  # connection items arrived on (for acks)
+        self.ack_stride = ack_stride
+
+    def finish(self, total: int | None, error):
+        with self.cond:
+            if self.done:
+                return
+            if error is not None:
+                self.error = error
+                # Drain whatever made it here, then raise.
+                self.total = self.produced
+            else:
+                self.total = self.produced if total is None else total
+            self.done = True
+            self.cond.notify_all()
+
+    def conn_lost(self, error):
+        """The connection items were riding died. Items and the completion
+        reply ride two independently-flushed batch pushers, so a completion
+        (total=N) can be processed while trailing items are still buffered
+        executor-side; if the conn then dies those items are gone forever —
+        truncate the stream with an error instead of waiting on gs.cond
+        for items that can never arrive."""
+        with self.cond:
+            if self.done and self.error is None and self.total is not None \
+                    and self.produced < self.total:
+                self.error = error
+                self.total = self.produced
+                self.cond.notify_all()
+
+
+class ObjectRefGenerator:
+    """Iterator of ObjectRefs from a `num_returns="streaming"` task
+    (reference python/ray/_raylet.pyx ObjectRefGenerator). next() blocks
+    until the executor reports the next yielded item; the stream ends with
+    StopIteration, or raises the task's error after the last good item."""
+
+    def __init__(self, worker: "Worker", task_id: str, completion_ref: "ObjectRef"):
+        self._worker = worker
+        self._task_id = task_id
+        # Holding the completion ref keeps its resolution (and the error
+        # path) alive for the generator's lifetime.
+        self._completion_ref = completion_ref
+
+    @property
+    def task_id(self) -> str:
+        return self._task_id
+
+    def completed(self) -> "ObjectRef":
+        """Ref that resolves to the item count when the stream finishes
+        (or raises the stream's error)."""
+        return self._completion_ref
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self._next(None)
+
+    def next(self, timeout: float | None = None):
+        """Like __next__ but raises GetTimeoutError after `timeout`."""
+        return self._next(timeout)
+
+    def _next(self, timeout: float | None):
+        w = self._worker
+        gs = w._generators.get(self._task_id)
+        if gs is None:
+            raise StopIteration
+        deadline = None if timeout is None else time.monotonic() + timeout
+        need_ack = False
+        with gs.cond:
+            while True:
+                if gs.queue:
+                    oid = gs.queue.popleft()
+                    gs.consumed += 1
+                    need_ack = (gs.ack_stride > 0 and gs.conn is not None
+                                and gs.consumed % gs.ack_stride == 0)
+                    break
+                if gs.done and not gs.queue and (
+                        gs.total is None or gs.consumed >= gs.total):
+                    w._generators.pop(self._task_id, None)
+                    if gs.error is not None:
+                        raise w._decode_error(gs.error)
+                    raise StopIteration
+                rem = None if deadline is None else deadline - time.monotonic()
+                if rem is not None and rem <= 0:
+                    raise exc.GetTimeoutError(
+                        f"generator {self._task_id[:12]} timed out")
+                gs.cond.wait(rem if rem is not None else 1.0)
+        if need_ack:
+            try:
+                gs.conn.push_threadsafe(
+                    "gen_ack", task_id=self._task_id, consumed=gs.consumed)
+            except Exception:
+                pass
+        return ObjectRef(oid, owned=True, worker=w)
+
+    def cancel(self, force: bool = False):
+        return self._worker.cancel_task(self._task_id, force)
+
+    def __del__(self):
+        try:
+            self._worker._gen_destroy(self._task_id)
+        except Exception:
+            pass
+
+    def __reduce__(self):
+        raise TypeError(
+            "ObjectRefGenerator cannot be pickled; consume it in the owner "
+            "process and pass the yielded ObjectRefs instead.")
+
+
 _global_worker: Optional["Worker"] = None
 _global_lock = threading.Lock()
 
@@ -276,6 +416,12 @@ class Worker:
         self._submit_lock = threading.Lock()
         self._submit_buf: list = []
         self._submit_flushing = False
+        # Streaming generators owned by this process: task_id -> _GenState.
+        self._generators: dict[str, _GenState] = {}
+        # Hooks used by worker_proc: consumer acks for generator
+        # backpressure, and consumer-side stream abandonment.
+        self.gen_ack_handler = None  # def (task_id, consumed)
+        self.gen_close_handler = None  # def (task_id)
         # Hook used by worker_proc to execute actor calls in-order:
         self.actor_push_handler = None  # def (conn, spec)
         self.actor_batch_handler = None  # def (conn, list[spec]) — one frame
@@ -402,6 +548,14 @@ class Worker:
         elif method == "cancel":
             if self.task_cancel_handler is not None:
                 self.task_cancel_handler(a["task_id"])
+        elif method == "gen_ack":
+            h = self.gen_ack_handler
+            if h is not None:
+                h(a["task_id"], a["consumed"])
+        elif method == "gen_close":
+            h = self.gen_close_handler
+            if h is not None:
+                h(a["task_id"])
         elif method == "col_msg":
             cb = self.collective_msg_cb
             if cb is not None:
@@ -957,6 +1111,142 @@ class Worker:
         res = self._resolutions.get(oid)
         return res is not None and res.done
 
+    # ------------------------------------------------- streaming generators
+    def _gen_new(self, spec: TaskSpec) -> "ObjectRefGenerator":
+        """Register owner-side stream state for a streaming spec (whose
+        completion resolution must already exist) and return the public
+        generator object."""
+        comp_oid = spec.return_object_ids()[0]
+        thresh = CONFIG.generator_backpressure_items
+        # stride 0 = backpressure disabled: send no acks at all (the
+        # executor ignores them anyway).
+        stride = max(1, thresh // 4) if thresh > 0 else 0
+        gs = _GenState(spec.task_id, stride)
+        self._generators[spec.task_id] = gs
+        res = self._resolutions[comp_oid]
+
+        def _fin():
+            total, err = None, res.error
+            if err is None and res.inline is not None:
+                try:
+                    blob = (res.inline[0] if len(res.inline) == 1
+                            else b"".join(bytes(p) for p in res.inline))
+                    total = int(self._deserialize_blob(memoryview(blob)))
+                except Exception:
+                    total = None
+            gs.finish(total, err)
+
+        if not res.add_watcher(_fin):
+            _fin()
+        return ObjectRefGenerator(
+            self, spec.task_id, ObjectRef(comp_oid, owned=True, worker=self))
+
+    def _on_gen_items(self, conn, items):
+        """Incremental item reports from the executing worker (runs on the
+        IO loop; reference ReportGeneratorItemReturns handler). A retry
+        re-reports indices the owner already has — re-resolve (idempotent)
+        but never re-queue."""
+        closed: set[str] = set()
+        for tid, idx, result in items:
+            oid, inline, size, holder = result
+            gs = self._generators.get(tid)
+            if gs is None:
+                # Generator destroyed before the stream drained: drop the
+                # straggler and tell the executor to stop producing (its
+                # backpressure wait would otherwise never end — actor-task
+                # streams have no lease/controller cancel path).
+                res = self._resolutions.setdefault(oid, _Resolution())
+                res.resolve(inline, [tuple(holder)] if holder else [], None)
+                self._free([oid])
+                closed.add(tid)
+                continue
+            with gs.cond:
+                gs.conn = conn
+                fresh = idx >= gs.produced
+                if fresh:
+                    gs.produced = idx + 1
+            if fresh:
+                res = self._resolutions.setdefault(oid, _Resolution())
+                res.resolve(inline, [tuple(holder)] if holder else [], None)
+                with gs.cond:
+                    gs.queue.append(oid)
+                    gs.cond.notify_all()
+                if self._generators.get(tid) is not gs:
+                    # _gen_destroy ran between our registry fetch and the
+                    # append: its queue-snapshot free missed this item, so
+                    # drain-and-free here (double free is idempotent).
+                    with gs.cond:
+                        orphaned = list(gs.queue)
+                        gs.queue.clear()
+                    if orphaned:
+                        self._free(orphaned)
+                    closed.add(tid)
+            else:
+                # Retry re-report of an index we already have. Re-resolve
+                # ONLY if the resolution still exists (a live ref or queued
+                # item) — recreating one for a consumed-and-freed item would
+                # leak it forever.
+                res = self._resolutions.get(oid)
+                if res is not None:
+                    res.resolve(inline, [tuple(holder)] if holder else [], None)
+        for tid in closed:
+            try:
+                conn.push_threadsafe("gen_close", task_id=tid)
+            except Exception:
+                pass
+
+    def _gen_conn_lost(self, conn):
+        """Called by the lease manager / actor pipe when a connection that
+        carried stream items closes: truncate any stream whose trailing
+        items were provably lost (see _GenState.conn_lost). Streams whose
+        spec is still tracked (retry/fail) are handled by those paths."""
+        gens = [gs for gs in self._generators.values() if gs.conn is conn]
+        if not gens:
+            return
+        h, bufs = dumps_oob({
+            "type": "WorkerCrashedError",
+            "message": "stream truncated: executor connection lost with "
+                       "trailing items undelivered"})
+        for gs in gens:
+            gs.conn_lost([h, *bufs])
+
+    def _gen_destroy(self, task_id: str):
+        """Generator object GC'd: free unconsumed items, cancel a stream
+        still in flight (reference: deleting the generator cancels the task
+        and GCs unconsumed returns)."""
+        gs = self._generators.pop(task_id, None)
+        if gs is None or self._shutdown:
+            return
+        with gs.cond:
+            pending = list(gs.queue)
+            gs.queue.clear()
+            done = gs.done
+            conn = gs.conn
+        if pending:
+            try:
+                self._free(pending)
+            except Exception:
+                pass
+        if not done and conn is not None:
+            # Direct stop signal to the executor: actor-task streams have no
+            # cancel path through the lease manager or controller, and the
+            # producer may be parked in a backpressure wait.
+            try:
+                conn.push_threadsafe("gen_close", task_id=task_id)
+            except Exception:
+                pass
+        if not done:
+            # cancel_task blocks on the IO loop; __del__ may run on any
+            # thread (including the loop itself), so hop to a helper thread.
+            def _bg():
+                try:
+                    self.cancel_task(task_id, False)
+                except Exception:
+                    pass
+
+            threading.Thread(target=_bg, daemon=True,
+                             name="rt-gen-cancel").start()
+
     # --------------------------------------------------------- submit task
     def _register_function(self, fn) -> str:
         # Hot path: serializing the function (closure walk) costs far more
@@ -1099,6 +1389,12 @@ class Worker:
     def submit_task(self, fn, args, kwargs, *, name=None, num_returns=1, resources: ResourceSet,
                     strategy: SchedulingStrategy | None = None, max_retries: int | None = None,
                     retry_exceptions=False, runtime_env=None) -> list[ObjectRef]:
+        streaming = num_returns == STREAMING
+        if streaming and any(k.startswith("TPU") for k in resources.raw()):
+            raise ValueError(
+                "num_returns='streaming' tasks ride the direct lease path; "
+                "TPU tasks use controller dispatch. Host a streaming method "
+                "on a TPU actor instead.")
         if runtime_env:
             from ray_tpu._private import runtime_env as _rtenv
 
@@ -1126,10 +1422,16 @@ class Worker:
         refs = []
         for oid in spec.return_object_ids():
             self._resolutions[oid] = _Resolution()
-            if spec.max_retries != 0:
+            # Streaming tasks retry via lease requeue, not lineage: the
+            # controller-dispatch reconstruction path has no item transport.
+            if spec.max_retries != 0 and not streaming:
                 self._lineage[oid] = spec
             refs.append(ObjectRef(oid, owned=True, worker=self))
         self._pin_args_until_done(escapes, refs)
+        if streaming:
+            gen = self._gen_new(spec)
+            self.lease_mgr.submit(spec)
+            return gen
         # Direct path: lease workers by scheduling class and stream specs to
         # them (reference NormalTaskSubmitter lease pools). TPU tasks keep
         # the controller-dispatch path — they need a dedicated worker whose
@@ -1261,6 +1563,7 @@ class Worker:
             self._resolutions[oid] = _Resolution()
             refs.append(ObjectRef(oid, owned=True, worker=self))
         self._pin_args_until_done(escapes, refs)
+        gen = self._gen_new(spec) if num_returns == STREAMING else None
         pipe = self._actor_pipes.get(actor_id)
         if pipe is None:
             with self._submit_lock:
@@ -1268,7 +1571,7 @@ class Worker:
                 if pipe is None:
                     pipe = self._actor_pipes[actor_id] = _ActorPipe(self, actor_id)
         pipe.submit(spec, max(0, max_task_retries))
-        return refs
+        return gen if gen is not None else refs
 
     def _fail_actor_call(self, spec: TaskSpec, e: Exception):
         blob = {"type": "ActorDiedError", "message": str(e)}
@@ -1416,6 +1719,9 @@ class _ActorPipe:
             self.w._actor_info.pop(self.actor_id, None)
 
     async def _on_push(self, conn, method, a):
+        if method == "gen_items":
+            self.w._on_gen_items(conn, a["items"])
+            return
         if method != "tasks_done":
             return
         for item in a["done"]:
@@ -1430,6 +1736,7 @@ class _ActorPipe:
         self.conn = None
         if self.w._shutdown:
             return
+        self.w._gen_conn_lost(conn)
         self.w._actor_info.pop(self.actor_id, None)
         # Redistribute in-flight calls: retryable ones go back to the FRONT
         # of the queue in sequence order; the rest fail now.

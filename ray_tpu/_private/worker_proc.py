@@ -26,7 +26,7 @@ import traceback
 from ray_tpu._private import rpc
 from ray_tpu._private.rtconfig import CONFIG
 from ray_tpu._private.serialization import dumps_oob, serialize
-from ray_tpu._private.task_spec import ACTOR_CREATE, ACTOR_TASK, NORMAL, TaskSpec
+from ray_tpu._private.task_spec import ACTOR_CREATE, ACTOR_TASK, NORMAL, STREAMING, TaskSpec
 from ray_tpu._private.worker import ObjectRef, Worker, set_global_worker
 
 logger = logging.getLogger(__name__)
@@ -105,6 +105,12 @@ class WorkerProc:
         # in an earlier task report the cancellation immediately.
         self._pending_ltasks: dict = {}
         self._done_pushers: dict = {}  # owner conn -> _BatchPusher
+        # Streaming generators (executor side): per-conn item pushers and
+        # the consumer-ack table driving backpressure.
+        self._gen_pushers: dict = {}  # owner conn -> _BatchPusher
+        self._gen_acks: dict[str, int] = {}  # task_id -> items consumed
+        self._gen_closed: set[str] = set()  # consumer abandoned the stream
+        self._gen_cond = threading.Condition()
         self._prefetch_pool = None  # lazy: arg pre-localization threads
         self._advertise_pusher: _BatchPusher | None = None
         self._running = True
@@ -117,9 +123,18 @@ class WorkerProc:
         self.worker.actor_batch_handler = self._on_actor_batch
         self.worker.task_push_handler = self._on_task_push
         self.worker.task_cancel_handler = self._cancel_current
+        self.worker.gen_ack_handler = self._on_gen_ack
+        self.worker.gen_close_handler = self._on_gen_close
+
         # Long-lived pool workers serve many lease holders; drop a holder's
-        # batched reply pusher when its connection goes away.
-        self.worker.server_close_handler = lambda conn: self._done_pushers.pop(conn, None)
+        # batched reply pushers when its connection goes away.
+        def _prune(conn):
+            self._done_pushers.pop(conn, None)
+            self._gen_pushers.pop(conn, None)
+            with self._gen_cond:
+                self._gen_cond.notify_all()  # unblock backpressure waits
+
+        self.worker.server_close_handler = _prune
         self._advertise_pusher = _BatchPusher(
             self.worker.controller, "register_puts", "items")
         # Task events -> controller (reference task_event_buffer.h role):
@@ -283,17 +298,21 @@ class WorkerProc:
             if group is not None and group not in self.actor_concurrency_groups:
                 group = None  # undeclared group: fall back to default routing
             ent = self._method_cache[spec.method_name] = (
-                m, m is not None and inspect.iscoroutinefunction(m), group)
+                m, m is not None and (inspect.iscoroutinefunction(m)
+                                      or inspect.isasyncgenfunction(m)), group)
         group = ent[2] if ent is not None else None
+        # Streaming item reports ride the caller's connection (the one the
+        # reply pusher is bound to).
+        conn = reply_slot.conn if reply_slot is not None else None
         if ent is not None and ent[1]:
             self._ensure_actor_loop()
             cf = asyncio.run_coroutine_threadsafe(
-                self._a_exec_actor_task(spec, group), self._actor_loop.loop)
+                self._a_exec_actor_task(spec, group, conn), self._actor_loop.loop)
             cf.add_done_callback(
                 lambda f, rs=reply_slot, tid=spec.task_id: self._reply_future(rs, tid, f))
         elif group is not None:
             cf = self._group_pool(group).submit(
-                self._execute_group_task, spec, group)
+                self._execute_group_task, spec, group, conn)
             cf.add_done_callback(
                 lambda f, rs=reply_slot, tid=spec.task_id: self._reply_future(rs, tid, f))
         elif self.actor_max_concurrency > 1:
@@ -302,11 +321,11 @@ class WorkerProc:
 
                 self._actor_pool = ThreadPoolExecutor(max_workers=self.actor_max_concurrency,
                                                       thread_name_prefix="rt-actor")
-            cf = self._actor_pool.submit(self._execute_actor_task, spec)
+            cf = self._actor_pool.submit(self._execute_actor_task, spec, conn)
             cf.add_done_callback(
                 lambda f, rs=reply_slot, tid=spec.task_id: self._reply_future(rs, tid, f))
         else:
-            reply = self._execute_actor_task(spec)
+            reply = self._execute_actor_task(spec, conn)
             self._reply_value(reply_slot, spec.task_id, reply)
 
     def _group_pool(self, group: str):
@@ -332,11 +351,11 @@ class WorkerProc:
             sem = self._group_budgets[group] = threading.Semaphore(limit)
         return sem
 
-    def _execute_group_task(self, spec: TaskSpec, group: str):
+    def _execute_group_task(self, spec: TaskSpec, group: str, conn=None):
         sem = self._group_budget(group)
         sem.acquire()  # pool thread; blocking is fine
         try:
-            return self._execute_actor_task(spec)
+            return self._execute_actor_task(spec, conn)
         finally:
             sem.release()
 
@@ -361,24 +380,43 @@ class WorkerProc:
             await asyncio.sleep(0.002)
         return sem.release
 
-    async def _a_exec_actor_task(self, spec: TaskSpec, group: str | None = None) -> dict:
+    async def _a_exec_actor_task(self, spec: TaskSpec, group: str | None = None,
+                                 conn=None) -> dict:
         release = await self._a_acquire_group(group)
         try:
-            return await self._a_exec_actor_task_inner(spec)
+            return await self._a_exec_actor_task_inner(spec, conn)
         finally:
             release()
 
-    async def _a_exec_actor_task_inner(self, spec: TaskSpec) -> dict:
+    async def _a_exec_actor_task_inner(self, spec: TaskSpec, conn=None) -> dict:
         error_blob = None
         value = None
+        streaming = spec.num_returns == STREAMING
+        gen_count = 0
         t0 = time.time()
         try:
             method = getattr(self.actor_instance, spec.method_name)
             args, kwargs = self.worker.decode_args(spec.args, spec.kwargs)
-            value = await method(*args, **kwargs)
+            r = method(*args, **kwargs)
+            if hasattr(r, "__anext__"):
+                if not streaming:
+                    raise TypeError(
+                        f"async generator method {spec.method_name!r} "
+                        f"requires num_returns='streaming'")
+                value = r
+            else:
+                value = await r
+            if streaming:
+                gen_count, gerr, _ = await self._a_stream_generator(
+                    spec, value, conn)
+                if gerr is not None:
+                    error_blob = gerr
         except BaseException as e:  # noqa: BLE001
             error_blob = self._make_error_blob(spec, e)
         self._record_event(spec, t0, time.time(), error_blob is None)
+        if streaming:
+            return {"results": self._package_stream_completion(
+                spec, gen_count, error_blob), "error": error_blob}
         return self._finish_actor_task(spec, value, error_blob)
 
     def _reply_value(self, pusher, task_id: str, reply: dict):
@@ -408,6 +446,175 @@ class WorkerProc:
         except Exception:
             pass  # observability must never break execution
 
+    # ------------------------------------------------ streaming generators
+    def _on_gen_ack(self, task_id: str, consumed: int):
+        with self._gen_cond:
+            # Only update LIVE streams (registered by the stream loop): a
+            # late ack landing after the stream's finally-pop must not
+            # re-create the entry — long-lived workers would leak one dict
+            # slot per streaming task served.
+            if task_id in self._gen_acks and consumed > self._gen_acks[task_id]:
+                self._gen_acks[task_id] = consumed
+                self._gen_cond.notify_all()
+
+    def _on_gen_close(self, task_id: str):
+        """Owner dropped its ObjectRefGenerator: stop producing. This is the
+        only stop path for actor-task streams (no lease/controller cancel
+        reaches them) and it also unblocks a parked backpressure wait.
+        Only LIVE streams are marked (same guard as _on_gen_ack): a close
+        landing after the stream's finally would leak a set entry per
+        abandoned stream in a long-lived worker. A close that beats the
+        stream's start is re-sent by the owner on every later straggler
+        item, so the live stream still learns of it."""
+        with self._gen_cond:
+            if task_id in self._gen_acks:
+                self._gen_closed.add(task_id)
+                self._gen_cond.notify_all()
+
+    def _gen_pusher_for(self, conn) -> "_BatchPusher | None":
+        pusher = self._gen_pushers.get(conn)
+        if pusher is None and conn is not None and not conn.closed:
+            pusher = self._gen_pushers[conn] = _BatchPusher(
+                conn, "gen_items", "items")
+            if conn.closed:
+                # Raced with the close between the check and the insert (the
+                # on_close prune may already have run and found nothing):
+                # prune our own insert — same pattern as _pusher_for.
+                self._gen_pushers.pop(conn, None)
+        return pusher
+
+    def _serialize_return(self, oid: str, value) -> tuple:
+        """Serialize ONE return value into its wire/result tuple
+        (oid, inline, size, holder): small inline, large into the node shm
+        store with the agent as the advertised holder (it outlives workers).
+        Shared by regular returns and streamed generator items so the inline
+        threshold / detach / escaping-ref rules can never diverge."""
+        sobj = serialize(value, ref_class=ObjectRef)
+        if sobj.contained_refs:
+            # Returned refs escape to the caller here: refs THIS worker owns
+            # (results of its own sub-calls) must reach the controller
+            # before the borrower can possibly wait on them.
+            self.worker._advertise_escaping(
+                [r.hex() if isinstance(r, ObjectRef) else r
+                 for r in sobj.contained_refs])
+        size = sobj.total_bytes()
+        if size <= CONFIG.max_inline_object_bytes:
+            return (oid, [sobj.to_bytes()], size, None)
+        self.worker.store.put(oid, sobj.to_parts())
+        # Drop the producer's mapping: the agent is the advertised holder,
+        # and keeping it would pin freed pages until this worker exits
+        # (same-host readers re-attach from the file).
+        self.worker.store.detach(oid)
+        return (oid, None, size, self.agent_addr)
+
+    def _package_one(self, spec: TaskSpec, idx: int, value) -> tuple:
+        """Package ONE yielded stream item, advertising shm items to the
+        controller immediately so third-party borrowers can fetch."""
+        oid = spec.task_id + idx.to_bytes(4, "little").hex()
+        result = self._serialize_return(oid, value)
+        if result[3] is not None:
+            self._advertise_pusher.add(
+                {"oid": oid, "size": result[2], "inline": None,
+                 "holder": result[3], "owner": spec.owner_id, "error": None})
+        return result
+
+    def _stream_generator(self, spec: TaskSpec, value, conn):
+        """Drive a sync generator/iterable, reporting each item to the owner
+        as it is yielded (reference ReportGeneratorItemReturns,
+        core_worker.proto:478). Returns (count, error_blob, exception).
+        Backpressure: pause once `generator_backpressure_items` items are
+        unacknowledged (acks ride `gen_ack` pushes from the consumer)."""
+        pusher = self._gen_pusher_for(conn)
+        thresh = CONFIG.generator_backpressure_items
+        tid = spec.task_id
+        with self._gen_cond:
+            self._gen_acks[tid] = 0  # register as live (acks update only live streams)
+        idx = 0
+        it = iter(value)
+        try:
+            for item in it:
+                with self._gen_cond:
+                    if tid in self._gen_closed:
+                        break  # consumer abandoned the stream
+                result = self._package_one(spec, idx, item)
+                if pusher is not None:
+                    pusher.add((tid, idx, result))
+                idx += 1
+                if thresh > 0 and idx % thresh == 0:
+                    with self._gen_cond:
+                        while (idx - self._gen_acks.get(tid, 0) >= thresh
+                               and tid not in self._gen_closed
+                               and conn is not None and not conn.closed):
+                            self._gen_cond.wait(timeout=0.25)
+            return idx, None, None
+        except BaseException as e:  # noqa: BLE001 — user generator code
+            return idx, self._make_error_blob(spec, e), e
+        finally:
+            close = getattr(it, "close", None)
+            if close is not None:
+                try:
+                    close()  # run the generator's finally blocks
+                except Exception:
+                    pass
+            with self._gen_cond:
+                self._gen_acks.pop(tid, None)
+                self._gen_closed.discard(tid)
+
+    async def _a_stream_generator(self, spec: TaskSpec, value, conn):
+        """Async flavor for async-generator actor methods (runs on the actor
+        loop — backpressure waits must not block the loop)."""
+        pusher = self._gen_pusher_for(conn)
+        thresh = CONFIG.generator_backpressure_items
+        tid = spec.task_id
+        with self._gen_cond:
+            self._gen_acks[tid] = 0  # register as live
+        idx = 0
+        try:
+            if not hasattr(value, "__anext__"):
+                value = iter(value)
+            while True:
+                if tid in self._gen_closed:
+                    break  # consumer abandoned the stream
+                try:
+                    if hasattr(value, "__anext__"):
+                        item = await value.__anext__()
+                    else:
+                        item = next(value)
+                except (StopAsyncIteration, StopIteration):
+                    break
+                result = self._package_one(spec, idx, item)
+                if pusher is not None:
+                    pusher.add((tid, idx, result))
+                idx += 1
+                if thresh > 0 and idx % thresh == 0:
+                    while (idx - self._gen_acks.get(tid, 0) >= thresh
+                           and tid not in self._gen_closed
+                           and conn is not None and not conn.closed):
+                        await asyncio.sleep(0.005)
+            return idx, None, None
+        except BaseException as e:  # noqa: BLE001
+            return idx, self._make_error_blob(spec, e), e
+        finally:
+            aclose = getattr(value, "aclose", None)
+            if aclose is not None:
+                try:
+                    await aclose()
+                except Exception:
+                    pass
+            with self._gen_cond:
+                self._gen_acks.pop(tid, None)
+                self._gen_closed.discard(tid)
+
+    def _package_stream_completion(self, spec: TaskSpec, count: int,
+                                   error_blob) -> list:
+        """The streaming task's single declared return: the completion
+        sentinel, resolving to the item count (or carrying the error)."""
+        comp_oid = spec.return_object_ids()[0]
+        if error_blob is not None:
+            return [(comp_oid, None, 0, None)]
+        sobj = serialize(count, ref_class=ObjectRef)
+        return [(comp_oid, [sobj.to_bytes()], sobj.total_bytes(), None)]
+
     # ---------------------------------------------------------- execution
     def _package_results(self, spec: TaskSpec, value, error_blob):
         """Serialize return values: small inline, large into the node shm
@@ -427,24 +634,7 @@ class WorkerProc:
                 f"but returned {len(values)} values"
             )
         for oid, v in zip(oids, values):
-            sobj = serialize(v, ref_class=ObjectRef)
-            if sobj.contained_refs:
-                # Returned refs escape to the caller here: refs THIS worker
-                # owns (results of its own sub-calls) must reach the
-                # controller before the borrower can possibly wait on them.
-                self.worker._advertise_escaping(
-                    [r.hex() if isinstance(r, ObjectRef) else r
-                     for r in sobj.contained_refs])
-            size = sobj.total_bytes()
-            if size <= CONFIG.max_inline_object_bytes:
-                results.append((oid, [sobj.to_bytes()], size, None))
-            else:
-                self.worker.store.put(oid, sobj.to_parts())
-                # Drop the producer's mapping: the agent is the advertised
-                # holder, and keeping it would pin freed pages until this
-                # worker exits (same-host readers re-attach from the file).
-                self.worker.store.detach(oid)
-                results.append((oid, None, size, self.agent_addr))
+            results.append(self._serialize_return(oid, v))
         return results
 
     def _make_error_blob(self, spec: TaskSpec, e: BaseException):
@@ -543,6 +733,11 @@ class WorkerProc:
                 self.actor_max_concurrency = max(1, spec.max_concurrency)
                 self.actor_concurrency_groups = dict(spec.concurrency_groups or {})
             else:
+                if spec.num_returns == STREAMING:
+                    raise RuntimeError(
+                        "streaming generators are not supported on the "
+                        "controller dispatch path (TPU tasks / "
+                        "reconstruction); use the lease path or an actor")
                 fn = self.worker.load_function(spec.function_id)
                 args, kwargs = self.worker.decode_args(spec.args, spec.kwargs)
                 value = fn(*args, **kwargs)
@@ -598,6 +793,8 @@ class WorkerProc:
         error_blob = None
         value = None
         retryable = False
+        streaming = spec.num_returns == STREAMING
+        gen_count = 0
         saved_env: dict[str, str | None] = {}
         env_vars = spec.runtime_env.get("env_vars") or {}
         for k, v in env_vars.items():
@@ -616,6 +813,14 @@ class WorkerProc:
             fn = self.worker.load_function(spec.function_id)
             args, kwargs = self.worker.decode_args(spec.args, spec.kwargs)
             value = fn(*args, **kwargs)
+            if streaming:
+                # Stream items while still "executing" (cancel interrupts
+                # the iteration via the same SIGINT path).
+                gen_count, gerr, gexc = self._stream_generator(
+                    spec, value, conn)
+                if gerr is not None:
+                    error_blob = gerr
+                    retryable = self._exception_retryable(spec, gexc)
         except BaseException as e:  # noqa: BLE001 — user code may raise anything
             error_blob = self._make_error_blob(spec, e)
             retryable = self._exception_retryable(spec, e)
@@ -629,9 +834,13 @@ class WorkerProc:
                 else:
                     os.environ[k] = old
         try:
-            results = self._package_results(spec, value, error_blob)
+            results = (self._package_stream_completion(spec, gen_count, error_blob)
+                       if streaming
+                       else self._package_results(spec, value, error_blob))
         except KeyboardInterrupt:
-            results = self._package_results(spec, value, error_blob)
+            results = (self._package_stream_completion(spec, gen_count, error_blob)
+                       if streaming
+                       else self._package_results(spec, value, error_blob))
         except BaseException as e:
             error_blob = self._make_error_blob(spec, e)
             results = self._package_results(spec, None, error_blob)
@@ -662,9 +871,11 @@ class WorkerProc:
             except KeyboardInterrupt:
                 continue
 
-    def _execute_actor_task(self, spec: TaskSpec) -> dict:
+    def _execute_actor_task(self, spec: TaskSpec, conn=None) -> dict:
         error_blob = None
         value = None
+        streaming = spec.num_returns == STREAMING
+        gen_count = 0
         t0 = time.time()
         try:
             if self.actor_instance is None:
@@ -677,9 +888,16 @@ class WorkerProc:
                 value = method(*args, **kwargs)
             else:
                 value = method()
+            if streaming:
+                gen_count, gerr, _ = self._stream_generator(spec, value, conn)
+                if gerr is not None:
+                    error_blob = gerr
         except BaseException as e:  # noqa: BLE001
             error_blob = self._make_error_blob(spec, e)
         self._record_event(spec, t0, time.time(), error_blob is None)
+        if streaming:
+            return {"results": self._package_stream_completion(
+                spec, gen_count, error_blob), "error": error_blob}
         return self._finish_actor_task(spec, value, error_blob)
 
     def _finish_actor_task(self, spec: TaskSpec, value, error_blob) -> dict:

@@ -220,7 +220,10 @@ class Autoscaler:
             n = alive.get(nid)
             if n is None:
                 continue
-            idle = n["available"] == n["total"]
+            # Job drivers consume no controller-visible resources; the
+            # active_jobs count is the only signal a node is hosting one.
+            idle = (n["available"] == n["total"]
+                    and not n.get("active_jobs", 0))
             if not idle:
                 self._idle_since.pop(nid, None)
                 continue
@@ -233,7 +236,8 @@ class Autoscaler:
                 self._call("drain_node", node_id=nid, on=True)
                 fresh = self._call("state_snapshot")["nodes"].get(nid)
                 if fresh is None or not fresh["alive"] or \
-                        fresh["available"] != fresh["total"]:
+                        fresh["available"] != fresh["total"] or \
+                        fresh.get("active_jobs", 0):
                     self._call("drain_node", node_id=nid, on=False)
                     self._idle_since.pop(nid, None)
                     continue

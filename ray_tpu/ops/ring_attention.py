@@ -82,3 +82,21 @@ def ring_attention(q, k, v, *, axis_name: str, causal: bool = True):
     l = jnp.where(l == 0.0, 1.0, l)
     out = (acc / l).astype(q.dtype)  # [B, Hkv, rep, Sq_local, D]
     return out.transpose(0, 3, 1, 2, 4).reshape(b, s_local, hq, d)
+
+
+# `ray_tpu.ops.ring_attention` names BOTH this submodule and the lazily
+# re-exported function in the package namespace; importing this module
+# rebinds the package attribute to the module object (import machinery
+# setattr), which would turn `ray_tpu.ops.ring_attention(q, k, v)` into a
+# TypeError depending on import order. Making the module itself callable
+# keeps both access patterns working in every order.
+import sys as _sys
+import types as _types
+
+
+class _CallableModule(_types.ModuleType):
+    def __call__(self, *args, **kwargs):
+        return ring_attention(*args, **kwargs)
+
+
+_sys.modules[__name__].__class__ = _CallableModule

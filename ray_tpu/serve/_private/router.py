@@ -236,9 +236,13 @@ class Router:
         with self._lock:
             self._outstanding[rid] = self._outstanding.get(rid, 0) + 1
             if multiplexed_model_id:
-                lst = self._model_replicas.setdefault(multiplexed_model_id, [])
+                lst = self._model_replicas.pop(multiplexed_model_id, [])
                 if rid not in lst:
                     lst.append(rid)
+                # Re-insert at the end so the bound below evicts the
+                # least-recently-ROUTED id, not merely the oldest-inserted
+                # (a still-hot model must survive one-off stale ids).
+                self._model_replicas[multiplexed_model_id] = lst
                 # Bound the map: ids are client-supplied (HTTP header) and
                 # must not leak memory in a long-running proxy.
                 while len(self._model_replicas) > 512:
