@@ -274,28 +274,55 @@ def main():
     except Exception as e:
         log(f"  flash attention skipped: {e}")
 
-    # ---- LLM KV-cache decode throughput (single chip) --------------------
+    # ---- LLM continuous-batching decode throughput (single chip) ---------
     try:
         import jax
 
         if jax.devices()[0].platform == "tpu":
-            from ray_tpu.llm import LLMConfig, LLMEngine
+            from ray_tpu.llm import LLMConfig
+            from ray_tpu.llm.engine import ContinuousEngine, SamplingParams
 
             lcfg = LLMConfig(vocab_size=32000, d_model=1024, n_layers=8,
-                             n_heads=16, max_seq=1024, max_new_tokens=128,
-                             dtype="bfloat16")
-            eng = LLMEngine(lcfg)
-            prompts = np.random.randint(0, 32000, size=(8, 128))
-            # Warm with the SAME step count: the decode scan is compiled
-            # per n_steps, and a recompile must not land in the timed run.
-            eng.generate(prompts, max_new_tokens=128)
+                             n_heads=16, max_seq=1024, dtype="bfloat16")
+            eng = ContinuousEngine(lcfg, max_batch=8, decode_chunk=16)
+            rng = np.random.RandomState(0)
+            sp = SamplingParams(temperature=0.0, max_tokens=128)
+
+            def churn(n_reqs):
+                """Mixed batch churn: staggered submits with varied prompt
+                lengths — requests join/leave the running batch (the
+                continuous-batching case, not lockstep generate)."""
+                streams = []
+                total = 0
+                for i in range(n_reqs):
+                    plen = int(rng.choice([64, 128, 256]))
+                    smp = SamplingParams(temperature=0.0,
+                                         max_tokens=96 + 16 * (i % 3))
+                    streams.append(eng.submit(
+                        rng.randint(0, 32000, size=plen), smp))
+                    total += smp.max_tokens
+                for s in streams:
+                    s.tokens()
+                return total
+
+            # Warm EVERY prefill bucket the timed churn can draw (each
+            # bucket is its own compiled program; one landing inside the
+            # timed window would corrupt the number), then a churn for the
+            # chunk-size programs.
+            warm = [eng.submit(np.random.randint(0, 32000, size=p),
+                               SamplingParams(temperature=0.0, max_tokens=8))
+                    for p in (64, 128, 256)]
+            for s in warm:
+                s.tokens()
+            churn(8)  # warm: chunk sizes + admission interleavings
             t0 = time.perf_counter()
-            out = eng.generate(prompts, max_new_tokens=128)
+            total = churn(16)
             dt = time.perf_counter() - t0
-            tps = 8 * 128 / dt
+            tps = total / dt
             results["llm_decode_tokens_per_s"] = tps
-            log(f"  llm decode: {tps:,.0f} tok/s "
-                f"(bf16 kv-cache, b8, 1024d x 8L, prefill 128 + 128 new)")
+            log(f"  llm decode: {tps:,.0f} tok/s (continuous batching, "
+                f"16 mixed reqs over 8 slots, bf16, 1024d x 8L)")
+            eng.shutdown()
     except Exception as e:
         log(f"  llm decode skipped: {e}")
 

@@ -1200,7 +1200,13 @@ class Worker:
         carried stream items closes: truncate any stream whose trailing
         items were provably lost (see _GenState.conn_lost). Streams whose
         spec is still tracked (retry/fail) are handled by those paths."""
-        gens = [gs for gs in self._generators.values() if gs.conn is conn]
+        # conn is None: a completed stream that never received items on ANY
+        # connection (e.g. the completion landed but the executor died
+        # before flushing items) must still be truncated — conn_lost()
+        # itself requires done && produced < total, so fresh streams on
+        # other connections are untouched.
+        gens = [gs for gs in self._generators.values()
+                if gs.conn is conn or (gs.conn is None and gs.done)]
         if not gens:
             return
         h, bufs = dumps_oob({

@@ -527,10 +527,13 @@ class WorkerProc:
         pusher = self._gen_pusher_for(conn)
         thresh = CONFIG.generator_backpressure_items
         tid = spec.task_id
+        # iter() BEFORE registering as live: a non-iterable return raises
+        # here, and registering first would leak the _gen_acks entry (the
+        # finally below would never run).
+        it = iter(value)
         with self._gen_cond:
             self._gen_acks[tid] = 0  # register as live (acks update only live streams)
         idx = 0
-        it = iter(value)
         try:
             for item in it:
                 with self._gen_cond:
@@ -566,12 +569,13 @@ class WorkerProc:
         pusher = self._gen_pusher_for(conn)
         thresh = CONFIG.generator_backpressure_items
         tid = spec.task_id
+        # iter() BEFORE registering as live (see _stream_generator).
+        if not hasattr(value, "__anext__"):
+            value = iter(value)
         with self._gen_cond:
             self._gen_acks[tid] = 0  # register as live
         idx = 0
         try:
-            if not hasattr(value, "__anext__"):
-                value = iter(value)
             while True:
                 if tid in self._gen_closed:
                     break  # consumer abandoned the stream

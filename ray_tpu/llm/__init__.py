@@ -137,6 +137,19 @@ def batch_inference(ds, cfg: LLMConfig, *, concurrency: int = 1):
                           fn_constructor_args=(cfg,))
 
 
+def __getattr__(name):
+    # Lazy: the continuous engine / OpenAI surface pull in jax + serve.
+    if name in ("ContinuousEngine", "SamplingParams", "GenStream"):
+        from ray_tpu.llm import engine as _e
+
+        return getattr(_e, name)
+    if name in ("build_openai_app", "OpenAIServer", "ByteTokenizer"):
+        from ray_tpu.llm import openai as _o
+
+        return getattr(_o, name)
+    raise AttributeError(name)
+
+
 def build_llm_deployment(cfg: LLMConfig, *, name: str = "llm",
                          num_replicas: int = 1,
                          ray_actor_options: Optional[dict] = None):

@@ -1,0 +1,580 @@
+"""Continuous-batching LLM engine: the production serving core.
+
+Parity target: the engine seat the reference fills with vLLM
+(python/ray/llm/_internal/serve/deployments/llm/vllm/vllm_engine.py —
+continuous batching, sampling params, streaming token output, TP-sharded
+engine workers via vllm_models.py:123-137). TPU-native design:
+
+- **Slot KV cache**: fixed [max_batch, max_seq] per-layer cache buffers;
+  each in-flight request owns one slot. Requests join (bucketed-length
+  prefill compiled once per bucket, then a compiled scatter places the
+  slot) and leave independently — no lockstep. Fixed shapes mean every
+  decode step is the same compiled XLA program; a TPU cannot afford
+  vLLM's dynamic block tables, slots are the idiomatic equivalent.
+- **Chunked decode**: between admission points the engine runs
+  `decode_chunk` single-token steps under ONE lax.scan dispatch,
+  amortizing host->device latency while bounding join latency to a few
+  tokens. Single-token attention runs the Pallas decode kernel
+  (ops/decode_attention.py) against the slot cache.
+- **In-graph sampling**: temperature / top-k / top-p / greedy are
+  vectorized per-slot inside the compiled step (each slot carries its own
+  sampling params and PRNG key), so mixed request settings share a batch.
+- **TP over a mesh**: pass `mesh` (axis "tp") and params/caches shard via
+  the model's Megatron PartitionSpecs; XLA inserts the ICI collectives.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class SamplingParams:
+    """reference vllm SamplingParams subset (the fields the serve layer
+    forwards; vllm_engine.py maps OpenAI body fields onto these)."""
+
+    temperature: float = 1.0
+    top_k: int = 0  # 0 = disabled
+    top_p: float = 1.0
+    max_tokens: int = 16
+    stop_token: Optional[int] = None
+    seed: int = 0
+
+
+class GenStream:
+    """Host-side token stream of one request: iterate to receive token ids
+    as the engine emits them; ends with StopIteration (or raises the
+    engine's error)."""
+
+    _DONE = object()
+
+    def __init__(self, request_id: int, prompt_len: int):
+        self.request_id = request_id
+        self.prompt_len = prompt_len
+        self._q: "queue.Queue" = queue.Queue()
+        self.finish_reason: Optional[str] = None
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        item = self._q.get()
+        if item is GenStream._DONE:
+            self._q.put(GenStream._DONE)  # idempotent re-next
+            raise StopIteration
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+    def next(self, timeout: Optional[float] = None):
+        item = self._q.get(timeout=timeout)
+        if item is GenStream._DONE:
+            self._q.put(GenStream._DONE)
+            raise StopIteration
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+    def tokens(self) -> list[int]:
+        """Drain the stream to completion."""
+        return list(self)
+
+
+def _make_sampler(vocab: int):
+    import jax
+    import jax.numpy as jnp
+
+    def sample(logits, keys, temp, top_k, top_p):
+        """logits [B, V] f32; keys [B, 2] uint32; temp/top_k/top_p [B].
+        temp <= 0 -> greedy. top_k <= 0 -> disabled. top_p >= 1 -> disabled
+        (the formula below then keeps every token)."""
+        greedy = jnp.argmax(logits, axis=-1)
+        lt = logits / jnp.maximum(temp, 1e-6)[:, None]
+        sorted_lt = jnp.sort(lt, axis=-1)[:, ::-1]
+        k_eff = jnp.clip(jnp.where(top_k > 0, top_k, vocab), 1, vocab)
+        kth = jnp.take_along_axis(sorted_lt, (k_eff - 1)[:, None], axis=-1)
+        lt = jnp.where(lt < kth, -jnp.inf, lt)
+        probs = jax.nn.softmax(lt, axis=-1)
+        sp = jnp.sort(probs, axis=-1)[:, ::-1]
+        csum = jnp.cumsum(sp, axis=-1)
+        # smallest prefix whose mass reaches top_p (always keeps the top
+        # token: csum - sp is 0 for it)
+        keep = (csum - sp) < top_p[:, None]
+        min_keep = jnp.min(jnp.where(keep, sp, jnp.inf), axis=-1,
+                           keepdims=True)
+        lt = jnp.where(probs < min_keep, -jnp.inf, lt)
+        sampled = jax.vmap(jax.random.categorical)(keys, lt)
+        return jnp.where(temp <= 0.0, greedy, sampled).astype(jnp.int32)
+
+    return sample
+
+
+class _Slot:
+    __slots__ = ("stream", "sampling", "remaining", "emitted")
+
+    def __init__(self, stream: GenStream, sampling: SamplingParams):
+        self.stream = stream
+        self.sampling = sampling
+        self.remaining = sampling.max_tokens
+        self.emitted = 0
+
+
+class ContinuousEngine:
+    """In-flight-batching engine over the flagship Transformer."""
+
+    def __init__(self, cfg, *, max_batch: int = 8, decode_chunk: int = 8,
+                 pipeline_depth: int = 4, mesh=None,
+                 prefill_buckets: tuple = ()):
+        import jax
+        import jax.numpy as jnp
+
+        from ray_tpu.llm import LLMConfig  # noqa: F401 (type)
+        from ray_tpu.models.transformer import Transformer, TransformerConfig
+
+        self.cfg = cfg
+        self.max_batch = max_batch
+        self.decode_chunk = decode_chunk
+        self.pipeline_depth = max(1, pipeline_depth)
+        self.mesh = mesh
+        mcfg = TransformerConfig(
+            vocab_size=cfg.vocab_size, d_model=cfg.d_model,
+            n_layers=cfg.n_layers, n_heads=cfg.n_heads,
+            n_kv_heads=cfg.n_heads, d_ff=int(cfg.d_model * 8 / 3) // 8 * 8,
+            max_seq=cfg.max_seq, dtype=jnp.dtype(cfg.dtype))
+        self.model = Transformer(mcfg)
+        if cfg.params is not None:
+            params = cfg.params["params"] if "params" in cfg.params else cfg.params
+        else:
+            dummy = jnp.zeros((1, 8), jnp.int32)
+            params = self.model.init(jax.random.PRNGKey(cfg.seed), dummy)["params"]
+        if mcfg.dtype == jnp.bfloat16:
+            # Inference needs no f32 master weights: pre-cast once so every
+            # decode step reads half the bytes (flax would otherwise cast
+            # f32->bf16 per call, paying f32 HBM reads each step).
+            params = jax.tree.map(
+                lambda x: x.astype(jnp.bfloat16)
+                if x.dtype == jnp.float32 else x, params)
+        if mesh is not None:
+            params = self._shard_params(params, mesh)
+        self.params = params
+        self._sampler = _make_sampler(cfg.vocab_size)
+        self._jax = jax
+        self._jnp = jnp
+        self._build_compiled()
+
+        # Host scheduler state.
+        self._lock = threading.Condition()
+        self._pending: "queue.Queue" = queue.Queue()
+        self._slots: list[Optional[_Slot]] = [None] * max_batch
+        self._lengths = np.zeros(max_batch, np.int32)  # next write position
+        self._next_tok = np.zeros(max_batch, np.int32)
+        # Sampling params live ON DEVICE (updated by .at[].set at admit):
+        # steady-state chunk dispatch must transfer nothing host->device.
+        self._temps_dev = jnp.zeros(max_batch, jnp.float32)
+        self._topks_dev = jnp.zeros(max_batch, jnp.int32)
+        self._topps_dev = jnp.ones(max_batch, jnp.float32)
+        self._keys = jax.vmap(jax.random.PRNGKey)(
+            jnp.arange(max_batch, dtype=jnp.uint32))
+        self._cache = None  # created lazily at first admit
+        self._req_counter = itertools.count()
+        self._n_active = 0
+        # Pipelining state: FIFO of dispatched-but-unread chunks, per-slot
+        # counts of dispatched-but-unemitted tokens, slots that must not be
+        # re-admitted until every in-flight chunk stepping them lands, and
+        # device-resident next-token/length mirrors so steady-state chunk
+        # dispatch needs NO host->device transfer.
+        self._q_chunks: list = []  # [(tokens_device, active, n, tag), ...]
+        self._pending_firsts: list = []  # [(slot, first_token_device), ...]
+        self._pending_toks = np.zeros(max_batch, np.int64)
+        self._cooling: dict[int, Any] = {}
+        self._toks_dev = jnp.zeros(max_batch, jnp.int32)
+        self._lens_dev = jnp.zeros(max_batch, jnp.int32)
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="rt-llm-engine")
+        self._thread.start()
+
+    # ------------------------------------------------------------ sharding
+    def _shard_params(self, params, mesh):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ray_tpu.models.transformer import param_specs
+
+        specs = param_specs({"params": params})["params"]
+
+        def _filter(spec):
+            # Drop mesh axes the caller's mesh doesn't have (e.g. a
+            # tp-only serving mesh has no fsdp/ep axis).
+            parts = []
+            for p in spec:
+                if p is None:
+                    parts.append(None)
+                elif isinstance(p, tuple):
+                    kept = tuple(a for a in p if a in mesh.axis_names)
+                    parts.append(kept if kept else None)
+                else:
+                    parts.append(p if p in mesh.axis_names else None)
+            return P(*parts)
+
+        return jax.tree.map(
+            lambda leaf, spec: jax.device_put(
+                leaf, NamedSharding(mesh, _filter(spec))),
+            params, specs)
+
+    # ------------------------------------------------------------ compiled
+    def _build_compiled(self):
+        import functools
+
+        import jax
+        import jax.numpy as jnp
+
+        model = self.model
+        sampler = self._sampler
+
+        def prefill(params, toks, plen):
+            """toks [1, Lb] -> (last-position logits [V], cache slice)."""
+            positions = jnp.arange(toks.shape[1])[None]
+            logits, vars_out = model.apply(
+                {"params": params}, toks, positions=positions, decode=True,
+                mutable=["cache"])
+            last = jax.lax.dynamic_index_in_dim(
+                logits[0].astype(jnp.float32), plen - 1, 0, keepdims=False)
+            return last, vars_out["cache"]
+
+        def place(cache, slice_cache, slot):
+            """Copy a [1, ...] prefill cache slice into batch row `slot`."""
+            return jax.tree.map(
+                lambda big, small: jax.lax.dynamic_update_slice(
+                    big, small.astype(big.dtype),
+                    (slot,) + (0,) * (small.ndim - 1)),
+                cache, slice_cache)
+
+        def sample1(logits, key, temp, top_k, top_p):
+            return sampler(logits[None], key[None], temp[None], top_k[None],
+                           top_p[None])[0]
+
+        def chunk(params, cache, toks, lengths, keys, temp, top_k, top_p,
+                  n: int, greedy: bool):
+            """n in-flight decode steps under one scan. toks/lengths [B];
+            returns (cache, keys, tokens [B, n], lengths [B]). greedy=True
+            compiles an argmax-only variant: the sampler's two full-vocab
+            sorts per step are pure waste when no active slot samples."""
+            def step(carry, _):
+                cache, tok, lens, keys = carry
+                logits, vars_out = model.apply(
+                    {"params": params, "cache": cache}, tok[:, None],
+                    positions=lens[:, None], decode=True, mutable=["cache"])
+                if greedy:
+                    nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+                else:
+                    split = jax.vmap(jax.random.split)(keys)  # [B, 2, 2]
+                    keys = split[:, 0]
+                    nxt = sampler(logits[:, -1].astype(jnp.float32),
+                                  split[:, 1], temp, top_k, top_p)
+                return (vars_out["cache"], nxt, lens + 1, keys), nxt
+
+            (cache, _tok, lens, keys), out = jax.lax.scan(
+                step, (cache, toks, lengths, keys), None, length=n)
+            return cache, keys, jnp.moveaxis(out, 0, 1), lens
+
+        self._prefill = jax.jit(prefill)
+        self._place = jax.jit(place, donate_argnums=(0,))
+        self._sample1 = jax.jit(sample1)
+        self._chunk = jax.jit(chunk, static_argnums=(8, 9),
+                              donate_argnums=(1,))
+
+    def _init_cache(self):
+        """Zero cache for the full batch, built by tracing one dummy step
+        (gives the exact per-layer cache structure at [max_batch, ...])."""
+        import jax
+        import jax.numpy as jnp
+
+        b = self.max_batch
+        toks = jnp.zeros((b, 1), jnp.int32)
+        positions = jnp.zeros((b, 1), jnp.int32)
+        shapes = jax.eval_shape(
+            lambda p, t, pos: self.model.apply(
+                {"params": p}, t, positions=pos, decode=True,
+                mutable=["cache"])[1]["cache"],
+            self.params, toks, positions)
+        cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), shapes)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            # KV-head axis over tp, matching the attention head sharding.
+            def _spec(leaf):
+                if leaf.ndim == 4:  # [B, S, KV, D]
+                    return NamedSharding(self.mesh, P(None, None, "tp", None))
+                return NamedSharding(self.mesh, P())
+
+            cache = jax.tree.map(
+                lambda leaf: jax.device_put(leaf, _spec(leaf)), cache)
+        return cache
+
+    # -------------------------------------------------------------- public
+    def submit(self, prompt_tokens, sampling: Optional[SamplingParams] = None
+               ) -> GenStream:
+        """Queue one request; returns its token stream immediately."""
+        if not self._running:
+            raise RuntimeError("engine is shut down")
+        sampling = sampling or SamplingParams()
+        prompt = np.asarray(prompt_tokens, np.int32).reshape(-1)
+        if len(prompt) == 0:
+            raise ValueError("empty prompt")
+        if len(prompt) + sampling.max_tokens > self.cfg.max_seq:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_tokens ({sampling.max_tokens}) "
+                f"exceeds max_seq ({self.cfg.max_seq})")
+        stream = GenStream(next(self._req_counter), len(prompt))
+        self._pending.put((prompt, sampling, stream))
+        with self._lock:
+            self._lock.notify_all()
+        return stream
+
+    def generate(self, prompts, sampling: Optional[SamplingParams] = None
+                 ) -> list[list[int]]:
+        """Batch convenience: submit all, drain all."""
+        streams = [self.submit(p, sampling) for p in prompts]
+        return [s.tokens() for s in streams]
+
+    def shutdown(self):
+        self._running = False
+        with self._lock:
+            self._lock.notify_all()
+        self._thread.join(timeout=10)
+
+    @property
+    def num_active(self) -> int:
+        return self._n_active
+
+    # ----------------------------------------------------------- scheduler
+    def _bucket(self, plen: int) -> int:
+        b = 8
+        while b < plen:
+            b *= 2
+        return min(b, self.cfg.max_seq)
+
+    def _admit_async(self, slot: int, prompt, sampling, stream):
+        """Dispatch prefill + first-token sample + cache place for one slot
+        WITHOUT reading the result back (the caller batches the host reads
+        of a whole admission wave into one device sync — each read is a
+        full round trip on tunneled/remote TPUs)."""
+        import jax.numpy as jnp
+
+        plen = len(prompt)
+        lb = self._bucket(plen)
+        toks = np.zeros((1, lb), np.int32)
+        toks[0, :plen] = prompt
+        if self._cache is None:
+            self._cache = self._init_cache()
+        last_logits, cache_slice = self._prefill(
+            self.params, jnp.asarray(toks), plen)
+        key = self._jax.random.fold_in(
+            self._jax.random.PRNGKey(sampling.seed), stream.request_id)
+        first = self._sample1(
+            last_logits, key,
+            jnp.float32(sampling.temperature),
+            jnp.int32(sampling.top_k), jnp.float32(sampling.top_p))
+        self._cache = self._place(self._cache, cache_slice,
+                                  self._jnp.int32(slot))
+        st = _Slot(stream, sampling)
+        self._slots[slot] = st
+        self._n_active += 1
+        self._lengths[slot] = plen
+        self._pending_toks[slot] = 0
+        self._temps_dev = self._temps_dev.at[slot].set(sampling.temperature)
+        self._topks_dev = self._topks_dev.at[slot].set(sampling.top_k)
+        self._topps_dev = self._topps_dev.at[slot].set(sampling.top_p)
+        self._keys = self._keys.at[slot].set(self._jax.random.fold_in(
+            key, 1))
+        return first  # device scalar
+
+    def _emit(self, slot: int, tok: int):
+        st = self._slots[slot]
+        st.stream._q.put(int(tok))
+        st.emitted += 1
+        st.remaining -= 1
+        stop = st.sampling.stop_token
+        if st.remaining <= 0 or (stop is not None and tok == stop):
+            st.stream.finish_reason = (
+                "stop" if (stop is not None and tok == stop) else "length")
+            self._retire(slot)
+
+    def _retire(self, slot: int):
+        st = self._slots[slot]
+        st.stream._q.put(GenStream._DONE)
+        self._slots[slot] = None
+        self._n_active -= 1
+        self._lengths[slot] = 0
+        self._next_tok[slot] = 0
+        # (device-side sampling mirrors keep stale values for retired
+        # slots; the slot decodes garbage that emit discards)
+        if self._q_chunks and slot in self._q_chunks[-1][1]:
+            # Already-dispatched chunks still step this slot; it must not
+            # be re-admitted until the NEWEST of them is emitted (device
+            # program order makes the cache safe — this guards only the
+            # host-side slot bookkeeping).
+            self._cooling[slot] = self._q_chunks[-1][3]
+
+    def _loop(self):
+        """Scheduler with depth-D software pipelining. Host syncs are the
+        scarce resource (a tunneled/remote TPU pays ~100ms per blocking
+        read): up to `pipeline_depth` decode chunks stay in flight with
+        their inputs chained ENTIRELY on device (next-token/length mirrors
+        ride chunk outputs, so steady-state dispatch transfers nothing),
+        and token readbacks happen one chunk per iteration — each read
+        overlaps the execution of every younger in-flight chunk.
+        Correctness leans on device program order (place/chunk chain
+        through the cache handle); the host only avoids re-admitting a
+        slot an in-flight chunk still steps (the _cooling set)."""
+        import jax.numpy as jnp
+
+        while self._running:
+            # ---- 1. admissions (batched: ONE device sync per wave)
+            admits = []
+            while (self._n_active + len(admits)) < self.max_batch:
+                free = next((i for i, s in enumerate(self._slots)
+                             if s is None and i not in self._cooling
+                             and all(i != a[0] for a in admits)), None)
+                if free is None:
+                    break
+                try:
+                    prompt, sampling, stream = self._pending.get_nowait()
+                except queue.Empty:
+                    break
+                try:
+                    first_dev = self._admit_async(free, prompt, sampling,
+                                                  stream)
+                    admits.append((free, first_dev))
+                    # Merge into the device mirrors without a sync.
+                    self._toks_dev = self._toks_dev.at[free].set(first_dev)
+                    self._lens_dev = self._lens_dev.at[free].set(
+                        int(self._lengths[free]))
+                except Exception as e:  # bad request or engine failure
+                    stream._q.put(e)
+                    stream._q.put(GenStream._DONE)
+            # First tokens are NOT read here: they join the next drain's
+            # single sync (an admission-wave readback would cost its own
+            # ~100ms round trip on tunneled TPUs).
+            self._pending_firsts.extend(admits)
+            if self._n_active == 0 and not self._q_chunks:
+                with self._lock:
+                    if self._pending.empty() and self._running:
+                        self._lock.wait(timeout=0.1)
+                continue
+            # ---- 2. fill the pipeline: dispatch up to pipeline_depth
+            # chunks back to back (dispatches are asynchronous and nearly
+            # free; only the readback costs a round trip)
+            while len(self._q_chunks) < self.pipeline_depth:
+                active = [i for i, s in enumerate(self._slots)
+                          if s is not None]
+                if not active:
+                    break
+                budget = int(min(
+                    min(self._slots[i].remaining - self._pending_toks[i]
+                        for i in active),
+                    min(self.cfg.max_seq - int(self._lengths[i])
+                        for i in active)))
+                if budget < 1:
+                    break  # every active slot's fate is already in flight
+                # Power-of-2 chunk sizes only: each distinct scan length
+                # is its own compiled program, and an arbitrary shrinking
+                # budget would recompile on nearly every call.
+                n = max(1, min(self.decode_chunk,
+                               1 << (budget.bit_length() - 1)))
+                greedy = all(
+                    self._slots[i].sampling.temperature <= 0.0
+                    for i in active)
+                try:
+                    self._cache, self._keys, toks_out, lens_out = \
+                        self._chunk(
+                            self.params, self._cache,
+                            self._toks_dev, self._lens_dev,
+                            self._keys, self._temps_dev,
+                            self._topks_dev, self._topps_dev, n, greedy)
+                    # Chain on device; mirror lengths on host (every slot
+                    # steps n times — deterministic, no read needed).
+                    self._toks_dev = toks_out[:, n - 1]
+                    self._lens_dev = lens_out
+                    self._lengths = self._lengths + n
+                    for i in active:
+                        self._pending_toks[i] += n
+                    self._q_chunks.append((toks_out, active, n, object()))
+                except Exception as e:
+                    logger.exception("llm engine decode chunk failed")
+                    for i in active:
+                        self._slots[i].stream._q.put(e)
+                        self._retire(i)
+                    break
+            # ---- 3. drain: read the admission wave's first tokens AND
+            # every queued chunk in ONE device sync (a concatenated
+            # transfer costs the same round trip as one chunk's worth)
+            if self._q_chunks or self._pending_firsts:
+                q, self._q_chunks = self._q_chunks, []
+                firsts, self._pending_firsts = self._pending_firsts, []
+                parts = []
+                if firsts:
+                    col = jnp.zeros((self.max_batch, 1), jnp.int32)
+                    for slot, fdev in firsts:
+                        col = col.at[slot, 0].set(fdev)
+                    parts.append(col)
+                parts.extend(c[0] for c in q)
+                try:
+                    all_np = np.asarray(
+                        parts[0] if len(parts) == 1
+                        else jnp.concatenate(parts, axis=1))
+                except Exception as e:
+                    for slot, _f in firsts:
+                        if self._slots[slot] is not None:
+                            self._slots[slot].stream._q.put(e)
+                            self._retire(slot)
+                    for _t, p_active, _n, _tag in q:
+                        for i in p_active:
+                            if self._slots[i] is not None:
+                                self._slots[i].stream._q.put(e)
+                                self._retire(i)
+                    all_np = None
+                off = 0
+                if firsts and all_np is not None:
+                    for slot, _f in firsts:
+                        self._next_tok[slot] = int(all_np[slot, 0])
+                        self._emit(slot, int(all_np[slot, 0]))
+                if firsts:
+                    off = 1
+                for _toks_dev, p_active, pn, tag in q:
+                    if all_np is not None:
+                        for i in p_active:
+                            self._pending_toks[i] = max(
+                                0, self._pending_toks[i] - pn)
+                            if self._slots[i] is None:
+                                continue  # retired; tail is garbage
+                            for j in range(off, off + pn):
+                                if self._slots[i] is None:
+                                    break
+                                self._emit(i, int(all_np[i, j]))
+                            if self._slots[i] is not None:
+                                self._next_tok[i] = int(
+                                    all_np[i, off + pn - 1])
+                    off += pn
+                    self._cooling = {s: t for s, t in self._cooling.items()
+                                     if t is not tag}
+        # drain on shutdown
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                s.stream._q.put(GenStream._DONE)
+        while True:
+            try:
+                _p, _s, stream = self._pending.get_nowait()
+            except queue.Empty:
+                break
+            stream._q.put(GenStream._DONE)

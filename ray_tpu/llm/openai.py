@@ -1,0 +1,151 @@
+"""OpenAI-compatible serving surface for the continuous-batching engine.
+
+Parity target: the reference's OpenAI router + application builder
+(python/ray/llm/_internal/serve/deployments/routers/router.py — /v1/models,
+/v1/completions, /v1/chat/completions with SSE streaming — and
+builders/application_builders.py build_openai_app). The engine behind the
+routes is the native TPU ContinuousEngine (llm/engine.py) instead of vLLM;
+prompts are strings (byte-level tokenizer) or raw token lists.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Optional
+
+from ray_tpu.llm import LLMConfig
+from ray_tpu.llm.engine import ContinuousEngine, GenStream, SamplingParams
+
+
+class ByteTokenizer:
+    """Byte-level tokenizer: token = byte value; BOS=256, EOS=257. Needs
+    vocab_size >= 258. Stands in for the reference's HF tokenizer load
+    (model_loading_config) — swap in a trained tokenizer the same way."""
+
+    BOS = 256
+    EOS = 257
+    vocab_size = 258
+
+    def encode(self, text: str, *, bos: bool = True) -> list[int]:
+        toks = list(text.encode("utf-8"))
+        return ([self.BOS] if bos else []) + toks
+
+    def decode(self, tokens) -> str:
+        data = bytes(t for t in tokens if 0 <= t < 256)
+        return data.decode("utf-8", "replace")
+
+
+def _sampling_from_body(body: dict, default_max: int) -> SamplingParams:
+    return SamplingParams(
+        temperature=float(body.get("temperature", 1.0)),
+        top_k=int(body.get("top_k", 0)),
+        top_p=float(body.get("top_p", 1.0)),
+        max_tokens=int(body.get("max_tokens", default_max)),
+        stop_token=body.get("stop_token"),
+        seed=int(body.get("seed", 0)),
+    )
+
+
+class OpenAIServer:
+    """Deployment callable serving /v1/models, /v1/completions and
+    /v1/chat/completions (reference LLMRouter + LLMServer collapsed into
+    one deployment; the engine IS local, no second hop needed)."""
+
+    def __init__(self, cfg: LLMConfig, model_id: str = "ray-tpu-llm",
+                 max_batch: int = 8, decode_chunk: int = 8,
+                 default_max_tokens: int = 64):
+        self.cfg = cfg
+        self.model_id = model_id
+        self.default_max_tokens = default_max_tokens
+        self.tok = ByteTokenizer()
+        self.engine = ContinuousEngine(
+            cfg, max_batch=max_batch, decode_chunk=decode_chunk)
+
+    # ------------------------------------------------------------ helpers
+    def _encode_prompt(self, body: dict) -> list[int]:
+        if "messages" in body:  # chat form
+            text = "".join(
+                f"<{m.get('role', 'user')}>{m.get('content', '')}"
+                for m in body["messages"])
+            return self.tok.encode(text)
+        prompt = body.get("prompt", "")
+        if isinstance(prompt, list):
+            return [int(t) for t in prompt]  # raw token ids
+        return self.tok.encode(str(prompt))
+
+    def _completion_body(self, req_id: str, text: str, tokens: list[int],
+                         finish: Optional[str], chat: bool,
+                         stream_delta: bool = False) -> dict:
+        if chat:
+            key = "delta" if stream_delta else "message"
+            choice = {"index": 0, key: {"role": "assistant", "content": text},
+                      "finish_reason": finish}
+            obj = ("chat.completion.chunk" if stream_delta
+                   else "chat.completion")
+        else:
+            choice = {"index": 0, "text": text, "finish_reason": finish}
+            obj = "text_completion"
+        return {"id": req_id, "object": obj, "created": int(time.time()),
+                "model": self.model_id, "choices": [choice],
+                "token_ids": tokens}
+
+    # ------------------------------------------------------------- routes
+    def __call__(self, request):
+        path = request.path
+        if path.endswith("/v1/models") or path.endswith("/models"):
+            return {"object": "list",
+                    "data": [{"id": self.model_id, "object": "model",
+                              "owned_by": "ray_tpu"}]}
+        body = request.json() or {}
+        chat = "chat" in path or "messages" in body
+        prompt = self._encode_prompt(body)
+        sampling = _sampling_from_body(body, self.default_max_tokens)
+        req_id = f"cmpl-{int(time.time() * 1e6):x}"
+        stream = self.engine.submit(prompt, sampling)
+        if body.get("stream"):
+            return self._stream_chunks(req_id, stream, chat)
+        toks = stream.tokens()
+        return self._completion_body(
+            req_id, self.tok.decode(toks), toks, stream.finish_reason, chat)
+
+    def _stream_chunks(self, req_id: str, stream: GenStream, chat: bool):
+        """Generator of OpenAI SSE chunk dicts — one per token, as the
+        engine emits them (rides the core streaming-generator transport
+        through the replica/proxy)."""
+        def gen():
+            for tok in stream:
+                yield self._completion_body(
+                    req_id, self.tok.decode([tok]), [tok], None, chat,
+                    stream_delta=True)
+            yield self._completion_body(
+                req_id, "", [], stream.finish_reason or "length", chat,
+                stream_delta=True)
+        return gen()
+
+    def check_health(self):
+        if not self.engine._running:
+            raise RuntimeError("llm engine stopped")
+
+    def __del__(self):
+        try:
+            self.engine.shutdown()
+        except Exception:
+            pass
+
+
+def build_openai_app(cfg: LLMConfig, *, name: str = "llm",
+                     model_id: str = "ray-tpu-llm", num_replicas: int = 1,
+                     max_batch: int = 8, decode_chunk: int = 8,
+                     default_max_tokens: int = 64,
+                     ray_actor_options: Optional[dict] = None):
+    """Serve application exposing the OpenAI surface (reference
+    build_openai_app, application_builders.py)."""
+    from ray_tpu import serve
+
+    dep = serve.deployment(
+        OpenAIServer, name=name, num_replicas=num_replicas,
+        ray_actor_options=ray_actor_options)
+    return dep.bind(cfg, model_id=model_id, max_batch=max_batch,
+                    decode_chunk=decode_chunk,
+                    default_max_tokens=default_max_tokens)

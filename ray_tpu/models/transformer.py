@@ -85,43 +85,49 @@ class Attention(nn.Module):
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
         if decode:
-            out = self._cached_attention(q, k, v)
+            out = self._cached_attention(q, k, v, positions)
         else:
             out = dot_product_attention(q, k, v, causal=True)
         return nn.DenseGeneral(cfg.d_model, axis=(-2, -1), use_bias=False, name="wo",
                                dtype=cfg.dtype, param_dtype=cfg.param_dtype)(out)
 
-    def _cached_attention(self, q, k, v):
-        """Autoregressive KV-cache attention (the flax decode-cache pattern,
-        reference role: vLLM's paged KV cache): new k/v land in fixed
-        [B, max_seq, KV, D] buffers at the current index; queries attend
-        over everything cached so far. Fixed shapes keep every decode step
-        the same compiled program — no recompiles, no growing context
-        re-forward (the O(S^2)-per-token cost the naive path pays)."""
+    def _cached_attention(self, q, k, v, positions):
+        """Autoregressive KV-cache attention with PER-SEQUENCE positions
+        (reference role: vLLM's paged KV cache; here slot-per-sequence):
+        new k/v rows scatter into fixed [B, max_seq, KV, D] buffers at each
+        sequence's own absolute positions, so one compiled step can serve a
+        continuous batch whose members are at different depths (the
+        requirement of in-flight batching). Visibility for query i of
+        sequence b is t <= positions[b, i]; rows above a sequence's current
+        position are never visible, so stale pad/previous-request garbage
+        in the slot can never leak into attention. Single-token steps
+        (S==1, the serving hot loop) use the Pallas decode kernel
+        (ops/decode_attention.py)."""
         cfg = self.cfg
         b, s = q.shape[0], q.shape[1]
         ck = self.variable("cache", "k", lambda: jnp.zeros(
             (b, cfg.max_seq, cfg.n_kv_heads, cfg.head_dim), cfg.dtype))
         cv = self.variable("cache", "v", lambda: jnp.zeros(
             (b, cfg.max_seq, cfg.n_kv_heads, cfg.head_dim), cfg.dtype))
-        cidx = self.variable("cache", "idx",
-                             lambda: jnp.zeros((), jnp.int32))
-        cur = cidx.value
-        ck.value = jax.lax.dynamic_update_slice(
-            ck.value, k.astype(cfg.dtype), (0, cur, 0, 0))
-        cv.value = jax.lax.dynamic_update_slice(
-            cv.value, v.astype(cfg.dtype), (0, cur, 0, 0))
-        cidx.value = cur + s
+        pos = positions.astype(jnp.int32)
+        bidx = jnp.arange(b)[:, None]
+        ck.value = ck.value.at[bidx, pos].set(k.astype(cfg.dtype))
+        cv.value = cv.value.at[bidx, pos].set(v.astype(cfg.dtype))
         keys, vals = ck.value, cv.value
+        if s == 1:
+            from ray_tpu.ops.decode_attention import decode_attention
+
+            out = decode_attention(q[:, 0], keys, vals, pos[:, 0] + 1)
+            return out[:, None].astype(cfg.dtype)
         if cfg.n_kv_heads < cfg.n_heads:  # GQA: broadcast kv heads
             rep = cfg.n_heads // cfg.n_kv_heads
             keys = jnp.repeat(keys, rep, axis=2)
             vals = jnp.repeat(vals, rep, axis=2)
         scores = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32),
                             keys.astype(jnp.float32)) / (cfg.head_dim ** 0.5)
-        # position t is visible to query i iff t <= cur + i
+        # cache row t is visible to query i of sequence b iff t <= pos[b, i]
         t_pos = jnp.arange(cfg.max_seq)[None, None, None, :]
-        q_pos = (cur + jnp.arange(s))[None, None, :, None]
+        q_pos = pos[:, None, :, None]
         scores = jnp.where(t_pos <= q_pos, scores, -jnp.inf)
         probs = jax.nn.softmax(scores, axis=-1)
         out = jnp.einsum("bhst,bthd->bshd", probs,

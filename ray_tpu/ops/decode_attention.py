@@ -1,0 +1,170 @@
+"""Pallas decode attention: single-token queries against a KV cache.
+
+The serving hot loop is q=[B, 1, H, D] attending over a fixed [B, S, KV, D]
+cache with per-sequence valid lengths — shapes the prefill flash kernel
+rejects (Sq=1 violates its q-block tiling), which previously forced the
+O(Sq*Sk)-materializing XLA fallback every decode step (the r04 bench
+warning). This kernel blocks only the cache axis: one grid program per
+(batch, kv-head) pair streams the cache in VMEM-sized chunks, carrying
+f32 online-softmax state in scratch, with the per-sequence length applied
+as a column mask. GQA folds the q-head group for a kv head into the
+sublane axis of a single [rep, D] tile.
+
+Reference role: vLLM's paged-attention decode kernel (the engine seat
+python/ray/llm delegates; no TPU equivalent exists in the reference).
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+logger = logging.getLogger(__name__)
+
+NEG_INF = float("-inf")
+DEFAULT_BLOCK_K = 512
+
+
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
+                   acc_ref, *, scale: float, block_k: int, n_k_blocks: int):
+    ki = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    k_start = ki * block_k
+    # lengths live whole-array in SMEM (scalars can't tile into VMEM blocks)
+    length = len_ref[pl.program_id(0)]
+
+    @pl.when(k_start < length)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)  # [rep, D]
+        k = k_ref[0].astype(jnp.float32)  # [block_k, D]
+        v = v_ref[0].astype(jnp.float32)  # [block_k, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [rep, block_k]
+        cols = k_start + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(cols < length, s, NEG_INF)
+        m_prev = m_ref[:, 0:1]
+        l_prev = l_ref[:, 0:1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(ki == n_k_blocks - 1)
+    def _finish():
+        l = l_ref[:, 0:1]
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("block_k", "interpret"))
+def decode_attention_pallas(q, k_cache, v_cache, lengths, *,
+                            block_k: int = DEFAULT_BLOCK_K,
+                            interpret: bool = False):
+    """q: [B, H, D] (one new token per sequence); k/v_cache: [B, S, KV, D];
+    lengths: [B] int32 — rows [0, lengths[b]) of sequence b's cache are
+    valid (INCLUDING the just-written current token). Returns [B, H, D]."""
+    b, hq, d = q.shape
+    _, sk, hkv, _ = k_cache.shape
+    if hq % hkv != 0:
+        raise ValueError(f"Hq={hq} not a multiple of Hkv={hkv}")
+    rep = hq // hkv
+    block_k = min(block_k, sk)
+    if sk % block_k or block_k % 128:
+        raise ValueError(
+            f"cache length {sk} not divisible by lane-aligned block "
+            f"{block_k}")
+    scale = d ** -0.5
+    n_k = sk // block_k
+    # Pad the per-kv-head q group up to the 8-row sublane tile: padded rows
+    # are zeros (scores 0 -> uniform softmax -> finite garbage, sliced off).
+    rep_pad = max(rep, 8)
+
+    # [B*KV, rep_pad, D] q tiles; [B*KV, S, D] cache views.
+    qt = q.reshape(b, hkv, rep, d).reshape(b * hkv, rep, d)
+    if rep_pad != rep:
+        qt = jnp.pad(qt, ((0, 0), (0, rep_pad - rep), (0, 0)))
+    kt = k_cache.transpose(0, 2, 1, 3).reshape(b * hkv, sk, d)
+    vt = v_cache.transpose(0, 2, 1, 3).reshape(b * hkv, sk, d)
+    lens = jnp.broadcast_to(
+        lengths.astype(jnp.int32)[:, None], (b, hkv)).reshape(b * hkv)
+
+    kernel = functools.partial(
+        _decode_kernel, scale=scale, block_k=block_k, n_k_blocks=n_k)
+
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b * hkv, rep_pad, d), q.dtype),
+        grid=(b * hkv, n_k),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # lengths, whole array
+            pl.BlockSpec((1, rep_pad, d), lambda bh, ki: (bh, 0, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda bh, ki: (bh, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, rep_pad, d), lambda bh, ki: (bh, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((rep_pad, 128), jnp.float32),  # running max
+            pltpu.VMEM((rep_pad, 128), jnp.float32),  # running denom
+            pltpu.VMEM((rep_pad, d), jnp.float32),    # accumulator
+        ],
+        interpret=interpret,
+    )(lens, qt, kt, vt)
+    if rep_pad == rep:
+        return out.reshape(b, hq, d)
+    return out[:, :rep].reshape(b, hq, d)
+
+
+def _xla_decode_attention(q, k_cache, v_cache, lengths):
+    """Reference path (any backend): masked dense attention over the cache."""
+    b, hq, d = q.shape
+    _, sk, hkv, _ = k_cache.shape
+    if hkv < hq:
+        repn = hq // hkv
+        k_cache = jnp.repeat(k_cache, repn, axis=2)
+        v_cache = jnp.repeat(v_cache, repn, axis=2)
+    scores = jnp.einsum("bhd,bthd->bht", q.astype(jnp.float32),
+                        k_cache.astype(jnp.float32)) / (d ** 0.5)
+    mask = jnp.arange(sk)[None, None, :] < lengths[:, None, None]
+    scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bht,bthd->bhd", probs, v_cache.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+_warned = False
+
+
+def decode_attention(q, k_cache, v_cache, lengths, *, interpret: bool = False):
+    """Dispatcher: Pallas on TPU (or interpret for tests), XLA elsewhere.
+    q: [B, H, D]; caches [B, S, KV, D]; lengths [B] -> [B, H, D]."""
+    global _warned
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu or interpret:
+        try:
+            return decode_attention_pallas(
+                q, k_cache, v_cache, lengths, interpret=interpret)
+        except Exception as e:
+            if not _warned:
+                _warned = True
+                logger.warning("decode attention falling back to XLA: %s", e)
+    return _xla_decode_attention(q, k_cache, v_cache, lengths)

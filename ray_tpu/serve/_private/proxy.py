@@ -31,6 +31,7 @@ class Proxy:
         self._site = None
         self._started = False
         self._resolver = None
+        self._stream_pool = None  # dedicated: SSE waits pin a thread each
 
     async def ready(self) -> int:
         """Bind the HTTP server; returns the bound port."""
@@ -100,6 +101,20 @@ class Proxy:
         # reference multiplex header: routes to a replica with the model hot.
         model_id = request.headers.get("serve_multiplexed_model_id", "")
 
+        # Streaming requests (OpenAI-style {"stream": true} body or SSE
+        # Accept header) ride the replica's streaming generator and are
+        # written out as server-sent events as items arrive (reference
+        # proxy.py streaming ASGI responses).
+        want_stream = "text/event-stream" in request.headers.get("Accept", "")
+        if not want_stream and body[:1] == b"{":
+            try:
+                want_stream = bool(json.loads(body).get("stream"))
+            except Exception:
+                want_stream = False
+        if want_stream:
+            return await self._handle_streaming(request, req, router,
+                                                model_id, loop)
+
         async def _once():
             # assign only blocks when there are no replicas (rare), so the
             # executor thread is held for microseconds, not the request
@@ -124,6 +139,58 @@ class Proxy:
             logger.error("serve proxy error: %r", e)
             return web.Response(status=500, text=repr(e))
         return self._to_response(result)
+
+    async def _handle_streaming(self, request, req, router, model_id, loop):
+        """SSE response: one `data:` event per streamed item, then [DONE]."""
+        from aiohttp import web
+
+        try:
+            gen = await loop.run_in_executor(
+                None, lambda: router.assign(
+                    "__call__", (req,), {}, multiplexed_model_id=model_id,
+                    streaming=True))
+        except Exception as e:
+            logger.error("serve proxy stream assign error: %r", e)
+            return web.Response(status=500, text=repr(e))
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+            "Connection": "keep-alive"})
+        await resp.prepare(request)
+        if self._stream_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            # NOT the default executor: each active stream parks a thread
+            # in next() for its whole lifetime, and exhausting the shared
+            # pool would stall every other run_in_executor user (assigns,
+            # route polls) behind long LLM token streams.
+            self._stream_pool = ThreadPoolExecutor(
+                max_workers=256, thread_name_prefix="rt-sse")
+        it = iter(gen)
+        sentinel = object()
+        try:
+            while True:
+                # next() blocks until the replica reports the next item;
+                # keep the proxy loop free while waiting.
+                ref = await loop.run_in_executor(
+                    self._stream_pool, lambda: next(it, sentinel))
+                if ref is sentinel:
+                    break
+                item = await self._resolver.submit(ref)
+                if isinstance(item, bytes):
+                    data = item.decode("utf-8", "replace")
+                elif isinstance(item, str):
+                    data = item
+                else:
+                    data = json.dumps(item)
+                await resp.write(f"data: {data}\n\n".encode())
+        except Exception as e:
+            logger.error("serve proxy stream error: %r", e)
+            await resp.write(
+                f"data: {json.dumps({'error': repr(e)})}\n\n".encode())
+        await resp.write(b"data: [DONE]\n\n")
+        await resp.write_eof()
+        return resp
 
     def _to_response(self, result):
         from aiohttp import web

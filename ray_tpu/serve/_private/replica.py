@@ -59,6 +59,7 @@ class Replica:
             self.callable = callable_or_class
         self.ongoing = 0
         self.total = 0
+        self._stream_pool = None  # lazy; see handle_request_streaming
 
     async def ready(self) -> str:
         """Constructor finished (actor creation ran __init__); used as the
@@ -94,6 +95,51 @@ class Replica:
             if inspect.isawaitable(out):
                 out = await out
             return out
+        finally:
+            _multiplexed_model_id.reset(token)
+            self.ongoing -= 1
+
+    async def handle_request_streaming(self, method_name: str, args: tuple,
+                                       kwargs: dict,
+                                       multiplexed_model_id: str = ""):
+        """Streaming twin of handle_request: the user method returns an
+        (async) generator/iterable whose items are yielded incrementally to
+        the caller over the core streaming-generator transport (reference
+        serve streaming responses / vLLM token streams). Called with
+        num_returns='streaming' by the router/proxy."""
+        self.ongoing += 1
+        self.total += 1
+        token = _multiplexed_model_id.set(multiplexed_model_id)
+        try:
+            target = (self.callable if method_name == "__call__"
+                      else getattr(self.callable, method_name))
+            out = target(*args, **(kwargs or {}))
+            if inspect.isawaitable(out):
+                out = await out
+            if hasattr(out, "__anext__"):
+                async for item in out:
+                    yield item
+            elif hasattr(out, "__iter__") and not isinstance(
+                    out, (str, bytes, dict)):
+                # Sync iterables' next() may block on an engine stream; a
+                # DEDICATED pool (not the default executor) so long token
+                # streams can't starve handle_request's sync offloads.
+                if self._stream_pool is None:
+                    from concurrent.futures import ThreadPoolExecutor
+
+                    self._stream_pool = ThreadPoolExecutor(
+                        max_workers=64, thread_name_prefix="rt-repl-stream")
+                loop = asyncio.get_event_loop()
+                it = iter(out)
+                sentinel = object()
+                while True:
+                    item = await loop.run_in_executor(
+                        self._stream_pool, lambda: next(it, sentinel))
+                    if item is sentinel:
+                        break
+                    yield item
+            else:
+                yield out  # single-item "stream"
         finally:
             _multiplexed_model_id.reset(token)
             self.ongoing -= 1

@@ -193,8 +193,12 @@ class Router:
 
     # --------------------------------------------------------------- assign
     def assign(self, method_name: str, args: tuple, kwargs: dict,
-               timeout: float = 30.0, multiplexed_model_id: str = ""):
-        """Pick a replica and dispatch; returns the result ObjectRef.
+               timeout: float = 30.0, multiplexed_model_id: str = "",
+               streaming: bool = False):
+        """Pick a replica and dispatch; returns the result ObjectRef — or,
+        with streaming=True, an ObjectRefGenerator of incremental results
+        (the replica method runs as a streaming generator; reference
+        serve's streaming response path over RequestRouter).
         Multiplexed requests prefer replicas this router already routed the
         model to (reference multiplex cache locality), then fall back to
         pow-2-choices balancing."""
@@ -248,6 +252,16 @@ class Router:
                 while len(self._model_replicas) > 512:
                     self._model_replicas.pop(
                         next(iter(self._model_replicas)))
+        if streaming:
+            gen = handle.handle_request_streaming.options(
+                num_returns="streaming").remote(
+                    method_name, args, kwargs,
+                    multiplexed_model_id=multiplexed_model_id)
+            with self._lock:
+                # The completion sentinel resolves when the stream ends —
+                # exactly when the request stops being "outstanding".
+                self._tracked[gen.completed()] = rid
+            return gen
         ref = handle.handle_request.remote(
             method_name, args, kwargs,
             multiplexed_model_id=multiplexed_model_id)
@@ -319,31 +333,42 @@ class DeploymentHandle:
     def __init__(self, deployment: str,
                  controller_name: str = "_serve_controller",
                  method_name: str = "__call__",
-                 multiplexed_model_id: str = ""):
+                 multiplexed_model_id: str = "",
+                 stream: bool = False):
         self.deployment = deployment
         self.controller_name = controller_name
         self.method_name = method_name
         self.multiplexed_model_id = multiplexed_model_id
+        self.stream = stream
 
     @property
     def _router(self) -> Router:
         return get_router(self.controller_name, self.deployment)
 
     def options(self, method_name: Optional[str] = None,
-                multiplexed_model_id: Optional[str] = None) -> "DeploymentHandle":
+                multiplexed_model_id: Optional[str] = None,
+                stream: Optional[bool] = None) -> "DeploymentHandle":
         return DeploymentHandle(
             self.deployment, self.controller_name,
             method_name if method_name is not None else self.method_name,
             multiplexed_model_id if multiplexed_model_id is not None
-            else self.multiplexed_model_id)
+            else self.multiplexed_model_id,
+            stream if stream is not None else self.stream)
 
     def __getattr__(self, name: str) -> "DeploymentHandle":
         if name.startswith("_"):
             raise AttributeError(name)
         return DeploymentHandle(self.deployment, self.controller_name, name,
-                                self.multiplexed_model_id)
+                                self.multiplexed_model_id, self.stream)
 
-    def remote(self, *args, **kwargs) -> DeploymentResponse:
+    def remote(self, *args, **kwargs):
+        if self.stream:
+            # ObjectRefGenerator of incremental results (reference
+            # handle.options(stream=True) -> DeploymentResponseGenerator).
+            return self._router.assign(
+                self.method_name, args, kwargs,
+                multiplexed_model_id=self.multiplexed_model_id,
+                streaming=True)
         ref = self._router.assign(
             self.method_name, args, kwargs,
             multiplexed_model_id=self.multiplexed_model_id)
@@ -354,7 +379,7 @@ class DeploymentHandle:
     def __reduce__(self):
         return (DeploymentHandle,
                 (self.deployment, self.controller_name, self.method_name,
-                 self.multiplexed_model_id))
+                 self.multiplexed_model_id, self.stream))
 
     def __repr__(self):
         return f"DeploymentHandle({self.deployment!r})"
