@@ -25,6 +25,7 @@ import numpy as np
 BASELINES = {
     "single_client_tasks_sync": 963.0,
     "single_client_tasks_async": 7293.0,
+    "multi_client_tasks_async": 22747.0,
     "1_1_actor_calls_sync": 2043.0,
     "1_1_actor_calls_async": 8120.0,
     "n_n_actor_calls_async": 27273.0,
@@ -94,6 +95,23 @@ def main():
         "single client tasks async",
         lambda: ray_tpu.get([noop.remote() for _ in range(100)], timeout=120),
         multiplier=100)
+
+    # Multiple drivers submitting concurrently (reference ray_perf.py
+    # multi_client_tasks_async: 4 clients x async batches). Clients are
+    # worker-resident actors, each submitting its own task batches.
+    @ray_tpu.remote(num_cpus=0)
+    class TaskClient:
+        def run(self, n):
+            ray_tpu.get([noop.remote() for _ in range(n)], timeout=120)
+            return n
+
+    clients = [TaskClient.remote() for _ in range(4)]
+    ray_tpu.get([c.run.remote(10) for c in clients], timeout=120)
+    results["multi_client_tasks_async"] = timeit(
+        "multi client tasks async",
+        lambda: ray_tpu.get([c.run.remote(100) for c in clients],
+                            timeout=120),
+        multiplier=400)
 
     log("actor calls:")
     a = Actor.remote()

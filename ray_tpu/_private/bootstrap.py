@@ -34,8 +34,12 @@ class HeadNode:
         host: str = "127.0.0.1",
         port: int = 0,
         worker_env: dict | None = None,
+        session_id: str | None = None,
     ):
-        self.session_id = uuid.uuid4().hex
+        # An explicit session_id restarts a head INTO an existing session
+        # (controller-restart FT: surviving agents/workers keep their shm
+        # namespace and re-register).
+        self.session_id = session_id or uuid.uuid4().hex
         self.host = host
         self.port = port
         res = host_resources(num_cpus, num_tpus)

@@ -145,6 +145,10 @@ class Controller:
         # resources and brokers worker acquisition. lease_id -> entry.
         self.leases: dict[str, dict] = {}
         self._last_need_push = 0.0
+        self._lease_waiters = 0  # parked lease requests (fair-share signal)
+        # (owner, lease_entry, expiry): reasserted leases whose node agent
+        # hasn't re-registered yet (controller restart FT).
+        self._parked_reasserts: list[tuple] = []
         # worker_ids that ever hosted an actor instance: the fate-sharing
         # reaper must recognize an actor owner even after its entry's
         # worker_id was cleared by the death bookkeeping.
@@ -154,10 +158,46 @@ class Controller:
         if CONFIG.controller_persist_dir:
             self._restore_state()
             self._tasks.append(asyncio.ensure_future(self._persist_loop()))
+            if any(e.state == "RECOVERING" for e in self.actors.values()):
+                self._tasks.append(
+                    asyncio.ensure_future(self._reconcile_recovering()))
         self.port = await self.server.start(host, port)
         self._tasks.append(asyncio.ensure_future(self._schedule_loop()))
         self._tasks.append(asyncio.ensure_future(self._health_loop()))
         return self.port
+
+    async def _reconcile_recovering(self):
+        """Grace window after a restart for agents to re-report surviving
+        actor workers; whatever never shows up is re-created (detached, or
+        owner re-registered) or declared DEAD (reference: GCS restart
+        reconciliation, gcs_actor_manager restart-on-node-report)."""
+        await asyncio.sleep(max(
+            2.0, CONFIG.heartbeat_interval_s * CONFIG.num_heartbeats_timeout))
+        for aid, ent in list(self.actors.items()):
+            if ent.state != "RECOVERING":
+                continue
+            owner_alive = ent.spec.owner_id in self.client_conns
+            if ent.spec.lifetime == "detached" or owner_alive:
+                ent.state = "PENDING"
+                self.pending.append(ent.spec)
+                logger.info("actor %s did not survive the controller "
+                            "restart; re-creating", aid[:8])
+            else:
+                from ray_tpu._private.serialization import dumps_oob
+
+                ent.state = "DEAD"
+                h, bufs = dumps_oob({
+                    "type": "ActorDiedError",
+                    "message": f"actor {aid[:12]} did not survive the "
+                               f"controller restart (worker and owner gone)"})
+                ent.death_cause = [h, *bufs]
+                self._publish("actor", {"actor_id": aid, "state": "DEAD"})
+            # Either way: wake get_actor_info callers parked on RECOVERING.
+            for fut in ent.waiters:
+                if not fut.done():
+                    fut.set_result(None)
+            ent.waiters.clear()
+        self._kick()
 
     # ------------------------------------------------------- persistence
     # Reference: src/ray/gcs/store_client/redis_store_client.h — GCS state
@@ -188,10 +228,21 @@ class Controller:
             return
         self.kv = snap.get("kv", {})
         self.named_actors = snap.get("named_actors", {})
-        for aid, spec in snap.get("actors", []):
+        if snap.get("session_id"):
+            # Adopt the previous incarnation's session: agents/workers that
+            # survived the restart registered their shm segments under it.
+            self.session_id = snap["session_id"]
+        for item in snap.get("actors", []):
+            aid, spec = item[0], item[1]
             ent = _ActorEntry(spec)
+            ent.restarts_used = item[2] if len(item) > 2 else 0
+            # RECOVERING: the actor's worker may have SURVIVED the restart
+            # (agents outlive the controller). Wait for agents to re-report
+            # inventory; _reconcile_recovering re-creates whatever never
+            # shows up (reference: GCS restart reconciliation before any
+            # actor restart decisions).
+            ent.state = "RECOVERING"
             self.actors[aid] = ent
-            self.pending.append(spec)  # re-create once a node joins
         for pid, pg in snap.get("pgs", {}).items():
             self.pgs[pid] = {"state": "PENDING",
                              "bundles_raw": pg["bundles_raw"],
@@ -217,18 +268,22 @@ class Controller:
 
     def _build_snapshot(self) -> dict:
         return {
-            "kv": dict(self.kv),
+            "session_id": self.session_id,
             # names only for actors that are themselves persisted — a
             # dangling name->id mapping would break name reuse after restore
+            "kv": dict(self.kv),
             "named_actors": {
                 k: aid for k, aid in self.named_actors.items()
                 if (e := self.actors.get(aid)) is not None
-                and e.state != "DEAD" and e.spec.lifetime == "detached"},
-            # Only DETACHED actors (reference persists detached actors):
-            # everything else fate-shares with its owner, which did not
-            # survive the restart either.
-            "actors": [(aid, ent.spec) for aid, ent in self.actors.items()
-                       if ent.state != "DEAD" and ent.spec.lifetime == "detached"],
+                and e.state != "DEAD"},
+            # ALL live actors (not just detached): agents outlive a
+            # controller restart, so a surviving worker re-binds its actor
+            # entry on re-registration; only actors whose workers really
+            # died get re-created (detached / owner-alive) or declared DEAD
+            # by the reconcile sweep.
+            "actors": [(aid, ent.spec, ent.restarts_used)
+                       for aid, ent in self.actors.items()
+                       if ent.state != "DEAD"],
             "pgs": {pid: {"bundles_raw": pg["bundles_raw"],
                           "strategy": pg["strategy"], "name": pg.get("name")}
                     for pid, pg in self.pgs.items()},
@@ -299,6 +354,84 @@ class Controller:
                 self._reap_owned_actors(wid, conn.meta.get("mode")))
             asyncio.ensure_future(self._reap_borrows(wid))
 
+    def _reconcile_reported_worker(self, nid: str, node: "NodeState", w: dict):
+        """One inventory entry from a re-registering agent (controller
+        restart FT). Actors whose workers survived re-bind in place —
+        running calls on their direct pipes never noticed the outage."""
+        aid = w.get("actor_id")
+        held = w.get("resources")
+        if aid:
+            ent = self.actors.get(aid)
+            if ent is not None and ent.state in ("RECOVERING", "PENDING"):
+                try:
+                    self.pending.remove(ent.spec)  # un-queue a re-creation
+                except ValueError:
+                    pass
+                ent.state = "ALIVE"
+                ent.node_id = nid
+                ent.worker_id = w["worker_id"]
+                ent.address = tuple(w["address"])
+                self._actor_host_workers.add(w["worker_id"])
+                if held and not ent.resources_held:
+                    node.available.subtract(ResourceSet(_raw=held))
+                    ent.resources_held = True
+                for fut in ent.waiters:
+                    if not fut.done():
+                        fut.set_result(None)
+                ent.waiters.clear()
+                self._publish("actor", {"actor_id": aid, "state": "ALIVE"})
+                logger.info("actor %s re-bound to surviving worker %s",
+                            aid[:8], w["worker_id"][:8])
+        elif w.get("state") == "busy" and held:
+            # A controller-dispatched task still running; charge its
+            # resources so the scheduler doesn't oversubscribe the node.
+            node.available.subtract(ResourceSet(_raw=held))
+
+    async def _p_reassert_leases(self, conn, a):
+        """An owner re-declares leases it held across a controller restart
+        (the lease ids live with the owner; the agent's inventory only
+        shows 'leased' slots). A lease whose node hasn't re-registered YET
+        is parked and retried on node registration — owners and agents
+        reconnect independently, so in ~half of restarts the one-shot
+        reassert beats the agent; dropping it would oversubscribe the node
+        and leak the leased worker."""
+        owner = a.get("owner_id")
+        for ent in a.get("leases") or ():
+            if not self._apply_reassert(owner, ent):
+                self._parked_reasserts.append(
+                    (owner, ent, time.monotonic() + 30.0))
+        logger.info("owner %s reasserted %d leases",
+                    (owner or "?")[:8], len(a.get("leases") or ()))
+
+    def _apply_reassert(self, owner, ent) -> bool:
+        """Returns False if the lease's node is not (yet) registered."""
+        lid = ent["lease_id"]
+        if lid in self.leases:
+            return True
+        nid = ent.get("node_id")
+        node = self.nodes.get(nid)
+        if node is None or not node.alive:
+            return False
+        demand = ResourceSet(_raw=ent["resources"])
+        try:
+            self._consume_for(nid, ent["strategy"], demand)
+        except Exception:
+            node.available.subtract(demand)
+        self.leases[lid] = {
+            "owner": owner,
+            "node_id": nid,
+            "worker_id": ent["worker_id"],
+            "demand": demand.raw(),
+            "strategy": ent["strategy"],
+        }
+        return True
+
+    def _retry_parked_reasserts(self):
+        now = time.monotonic()
+        self._parked_reasserts = [
+            (owner, ent, exp) for owner, ent, exp in self._parked_reasserts
+            if exp > now and not self._apply_reassert(owner, ent)]
+
     async def _reap_borrows(self, wid: str):
         """A dead borrower can never drop its borrows: remove it from every
         borrower set; the dying-object sweep frees entries it was pinning
@@ -317,6 +450,16 @@ class Controller:
             self.nodes[nid] = node
             self.node_conns[nid] = conn
             conn.meta.update(kind="node", node_id=nid)
+            # Re-registration after a controller restart: the agent reports
+            # its live worker inventory so this (fresh) controller can
+            # rebuild accounting — bind recovering actors to their still-
+            # running workers; charge dedicated/busy slots' resources.
+            # Leased slots are charged by their OWNER's reassert_leases
+            # (the owner knows the lease ids; the agent doesn't).
+            for w in a.get("workers") or ():
+                self._reconcile_reported_worker(nid, node, w)
+            if self._parked_reasserts:
+                self._retry_parked_reasserts()
             self._retry_pending_pgs()
             self._kick()
             self._publish("node", {"node_id": nid, "alive": True,
@@ -697,15 +840,40 @@ class Controller:
         the lease when idle (reference RequestWorkerLease,
         node_manager.proto:404, with the submitter-side lease caching of
         normal_task_submitter.cc)."""
+        owner = conn.meta.get("worker_id") or a.get("owner_id")
+        demand = ResourceSet(_raw=a["resources"])
+        strategy = a["strategy"]
+        count = max(1, min(int(a.get("count", 1)), 64))
+        # Fair share under contention: while other requesters are parked
+        # waiting for capacity, one owner must not re-grab the whole pool.
+        others = max(0, self._lease_waiters)
+        granted = await self._grant_leases(
+            owner, demand, strategy, max(1, count // (1 + others)))
+        if not granted:
+            # Park the request briefly instead of replying empty: ask lease
+            # holders for idle returns and retry — client-side polling at
+            # REQUEST_RETRY_S granularity convoys concurrent submitters on
+            # the idle-return timer (observed 15x multi-client loss).
+            deadline = time.monotonic() + 0.4
+            self._lease_waiters += 1
+            try:
+                while not granted and time.monotonic() < deadline:
+                    self._maybe_push_need_resources()
+                    await asyncio.sleep(0.02)
+                    granted = await self._grant_leases(
+                        owner, demand, strategy,
+                        max(1, count // max(1, self._lease_waiters)))
+            finally:
+                self._lease_waiters -= 1
+        return {"leases": granted}
+
+    async def _grant_leases(self, owner, demand, strategy, count) -> list:
         import uuid
 
         import copy
 
-        owner = conn.meta.get("worker_id") or a.get("owner_id")
-        demand = ResourceSet(_raw=a["resources"])
-        strategy = a["strategy"]
         granted = []
-        for _ in range(max(1, min(int(a.get("count", 1)), 64))):
+        for _ in range(count):
             nid = pick_node(demand, strategy, self.nodes, self.pg_bundles)
             if nid is None:
                 break
@@ -725,7 +893,8 @@ class Controller:
                 # raises first we get a clean error reply; timing out here
                 # first would strand a slot in 'leased' with no lease entry.
                 rep = await nconn.call(
-                    "lease_worker", _timeout=CONFIG.worker_register_timeout_s + 5)
+                    "lease_worker", resources=demand.raw(),
+                    _timeout=CONFIG.worker_register_timeout_s + 5)
             except Exception:
                 self._release_for(nid, lease_strategy, demand)
                 break
@@ -743,7 +912,7 @@ class Controller:
                 "worker_id": rep["worker_id"],
                 "address": tuple(rep["address"]),
             })
-        return {"leases": granted}
+        return granted
 
     def _consume_for(self, nid: str, strategy, demand: ResourceSet):
         if strategy.kind == "PLACEMENT_GROUP":
@@ -1350,7 +1519,7 @@ class Controller:
         if ent is None:
             return {"status": "not_found"}
         deadline = time.monotonic() + a.get("timeout", 60.0)
-        while ent.state in ("PENDING", "RESTARTING") and a.get("wait", True):
+        while ent.state in ("PENDING", "RESTARTING", "RECOVERING") and a.get("wait", True):
             fut = asyncio.get_running_loop().create_future()
             ent.waiters.append(fut)
             try:

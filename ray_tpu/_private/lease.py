@@ -474,6 +474,27 @@ class LeaseManager:
                             pass
                 await self._a_return([l.lease_id for l in to_return])
 
+    def reassert(self):
+        """After a controller restart: re-declare every live lease so the
+        new controller can rebuild its lease table + resource accounting
+        (reference: raylets report held leases when the GCS restarts).
+        Runs on the IO loop (called from the reconnect coroutine)."""
+        entries = []
+        for lease in self._by_id.values():
+            if lease.dead:
+                continue
+            entries.append({
+                "lease_id": lease.lease_id,
+                "worker_id": lease.worker_id,
+                "node_id": lease.node_id,
+                "resources": lease.cls.resources,
+                "strategy": lease.cls.strategy,
+            })
+        if entries:
+            asyncio.ensure_future(self.w.controller.push(
+                "reassert_leases", leases=entries,
+                owner_id=self.w.worker_id))
+
     def on_need_resources(self):
         """Controller has demand it can't place: return idle leases now."""
         self.w.io.spawn(self._a_return_idle())

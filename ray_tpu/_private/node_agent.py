@@ -30,7 +30,8 @@ logger = logging.getLogger(__name__)
 
 class _WorkerSlot:
     __slots__ = ("worker_id", "proc", "conn", "state", "task_id", "actor_id", "address",
-                 "registered", "dedicated", "idle_since", "assigned_at")
+                 "registered", "dedicated", "idle_since", "assigned_at",
+                 "held_resources")
 
     def __init__(self, worker_id: str, proc, dedicated: bool = False):
         self.worker_id = worker_id
@@ -44,6 +45,10 @@ class _WorkerSlot:
         self.dedicated = dedicated  # spawned for an actor; never joins the pool
         self.idle_since: float = 0.0
         self.assigned_at: float = 0.0  # last task/lease/actor assignment time
+        # Raw resources this slot's lease/task/actor holds — reported on
+        # re-registration so a RESTARTED controller can rebuild accounting
+        # (reference RayletNotifyGCSRestart reconciliation).
+        self.held_resources: Optional[dict] = None
 
 
 class NodeAgent:
@@ -72,17 +77,29 @@ class NodeAgent:
         self._idle_waiters: deque = None  # set in start
         self._tasks: list[asyncio.Task] = []
         self._stopping = False
+        self._reconnecting = False  # single-flight controller reconnect
         self.port = 0
 
     async def start(self) -> int:
         self._idle_waiters = deque()
         self.port = await self.server.start(self.host, 0)
-        self.controller = await rpc.connect(
-            *self.controller_addr,
-            on_request=self._on_ctrl_request,
-            on_push=self._on_ctrl_push,
-            on_close=lambda c: None if self._stopping else os._exit(1) if os.environ.get("RT_AGENT_STANDALONE") else None,
-        )
+        # Initial connect retries like the reconnect path: a node joining
+        # while the controller restarts (or before it finishes binding)
+        # must not crash out on one refused connection.
+        deadline = time.monotonic() + CONFIG.connect_timeout_s
+        while True:
+            try:
+                self.controller = await rpc.connect(
+                    *self.controller_addr,
+                    on_request=self._on_ctrl_request,
+                    on_push=self._on_ctrl_push,
+                    on_close=self._on_ctrl_conn_close,
+                )
+                break
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise
+                await asyncio.sleep(0.5)
         rep = await self.controller.call(
             "register",
             kind="node",
@@ -113,13 +130,97 @@ class NodeAgent:
         self.store.shutdown()
 
     # -------------------------------------------------- controller channel
+    def _on_ctrl_conn_close(self, conn):
+        """The controller went away. Agents OUTLIVE a controller restart
+        (reference: raylets tolerate a GCS restart and re-register via
+        RayletNotifyGCSRestart, core_worker.proto:459): retry the same
+        address, then re-register with the current worker inventory so the
+        restarted controller can rebuild its accounting. Running work keeps
+        running throughout — leases/actor pipes are direct connections."""
+        if self._stopping:
+            return
+        asyncio.ensure_future(self._ctrl_reconnect())
+
+    def _worker_inventory(self) -> list:
+        out = []
+        for slot in self.workers.values():
+            if slot.proc.poll() is not None or slot.address is None:
+                continue
+            out.append({
+                "worker_id": slot.worker_id,
+                "address": tuple(slot.address),
+                "state": slot.state,
+                "task_id": slot.task_id,
+                "actor_id": slot.actor_id,
+                "dedicated": slot.dedicated,
+                "resources": slot.held_resources,
+            })
+        return out
+
+    async def _ctrl_reconnect(self):
+        if self._reconnecting:
+            return  # single-flight: abandoned conns' on_close must not fork
+        self._reconnecting = True
+        try:
+            await self._ctrl_reconnect_inner()
+        finally:
+            self._reconnecting = False
+
+    async def _ctrl_reconnect_inner(self):
+        deadline = time.monotonic() + CONFIG.controller_reconnect_timeout_s
+        logger.warning("agent %s: controller connection lost; retrying %s",
+                       self.node_id[:8], self.controller_addr)
+        while not self._stopping and time.monotonic() < deadline:
+            conn = None
+            try:
+                conn = await rpc.connect(
+                    *self.controller_addr,
+                    on_request=self._on_ctrl_request,
+                    on_push=self._on_ctrl_push,
+                    on_close=self._on_ctrl_conn_close,
+                    timeout=5,
+                )
+                rep = await conn.call(
+                    "register", kind="node", node_id=self.node_id,
+                    address=(self.host, self.port),
+                    resources=self.resources_raw, labels=self.labels,
+                    workers=self._worker_inventory(), _timeout=10)
+                self.controller = conn
+                CONFIG.load_snapshot(rep["config"])
+                self.logs_enabled = bool(rep.get("log_sub", False))
+                logger.info("agent %s: re-registered with restarted "
+                            "controller", self.node_id[:8])
+                return
+            except Exception:
+                if conn is not None and not conn.closed:
+                    try:
+                        await conn.close()
+                    except Exception:
+                        pass
+                await asyncio.sleep(0.5)
+        if self._stopping:
+            return
+        logger.error("agent %s: controller gone for %.0fs; shutting down",
+                     self.node_id[:8], CONFIG.controller_reconnect_timeout_s)
+        if os.environ.get("RT_AGENT_STANDALONE"):
+            os._exit(1)
+
     async def _on_ctrl_request(self, conn, method, a):
         if method == "dispatch":
             return await self._dispatch(a["spec"])
         if method == "lease_worker":
             slot = await self._acquire_pool_worker()
+            if conn.closed:
+                # The controller died while we were acquiring: the reply can
+                # never be delivered, and marking the slot leased would
+                # orphan it FOREVER (no owner will ever return it) while its
+                # ghost acquisition starves real waiters after the
+                # controller restarts. Re-idle and fail the dead request.
+                self._worker_became_idle(slot)
+                raise rpc.RpcError("controller connection closed mid-lease")
             slot.state = "leased"
             slot.assigned_at = time.monotonic()
+            slot.held_resources = a.get("resources")
             return {"worker_id": slot.worker_id, "address": slot.address}
         if method == "run_job":
             return self._run_job(a)
@@ -243,6 +344,10 @@ class NodeAgent:
             await self.stop()
 
     async def _heartbeat_loop(self):
+        # ONE loop for the agent's lifetime: it reads self.controller every
+        # beat, so it follows reconnects; failed pushes during an outage
+        # are simply skipped (respawning a loop per reconnect would
+        # accumulate duplicates).
         while True:
             await asyncio.sleep(CONFIG.heartbeat_interval_s)
             try:
@@ -250,7 +355,7 @@ class NodeAgent:
                     "heartbeat", node_id=self.node_id,
                     shm_used=self.store.shm_dir_usage())
             except Exception:
-                return
+                continue
 
     # ----------------------------------------------------- worker channel
     async def _on_request(self, conn, method, a):
@@ -298,6 +403,7 @@ class NodeAgent:
         slot = await self._acquire_worker(spec)
         slot.task_id = spec.task_id
         slot.assigned_at = time.monotonic()
+        slot.held_resources = dict(spec.resources or {})
         if spec.kind == ACTOR_CREATE:
             slot.state = "actor"
             slot.actor_id = spec.actor_id

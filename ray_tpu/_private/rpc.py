@@ -122,12 +122,23 @@ class Connection:
             await self.writer.drain()
 
     async def call(self, method: str, _timeout: float | None = None, **payload):
+        # Fail fast on a dead connection: the read loop already rejected
+        # and CLEARED _pending, so a future registered now would never
+        # resolve — the caller would await forever (observed: a lease
+        # request wedging its class's `requesting` flag permanently after
+        # a controller restart).
+        if self.closed:
+            raise ConnectionClosed("connection already closed")
         self._next_id += 1
         rid = self._next_id
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut
         try:
             await self._write({"k": "req", "id": rid, "m": method, "a": payload})
+            if self.closed and not fut.done():
+                # Raced the close between registration and the write (the
+                # reader's sweep may have missed this future).
+                raise ConnectionClosed("connection closed during call")
             if _timeout is not None:
                 return await asyncio.wait_for(fut, _timeout)
             return await fut
@@ -143,6 +154,8 @@ class Connection:
         in core_worker/transport/sequential_actor_submit_queue.h).
         The caller must consume the future (and pop it from _pending on error).
         """
+        if self.closed:
+            raise ConnectionClosed("connection already closed")
         self._next_id += 1
         rid = self._next_id
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
@@ -152,6 +165,9 @@ class Connection:
         except Exception:
             self._pending.pop(rid, None)
             raise
+        if self.closed and not fut.done():
+            self._pending.pop(rid, None)
+            raise ConnectionClosed("connection closed during call")
         def _done(f, rid=rid):
             self._pending.pop(rid, None)
         fut.add_done_callback(_done)

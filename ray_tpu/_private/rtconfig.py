@@ -44,6 +44,10 @@ _flag("prestart_workers", bool, True)
 _flag("idle_worker_keep_s", float, 300.0)
 _flag("scheduler_spread_threshold", float, 0.5)  # hybrid policy pack->spread knob
 _flag("lineage_reconstruction_enabled", bool, True)
+# Controller-restart FT (reference RayletNotifyGCSRestart,
+# core_worker.proto:459): agents/workers/drivers retry the controller
+# address this long before giving up (workers exit; drivers error).
+_flag("controller_reconnect_timeout_s", float, 30.0)
 # Borrower protocol: how long an owner-freed ESCAPED object survives at the
 # controller waiting for a borrower to register (covers the in-flight window
 # between the owner shipping a ref inside a payload and the receiving process

@@ -422,6 +422,9 @@ class Worker:
         # backpressure, and consumer-side stream abandonment.
         self.gen_ack_handler = None  # def (task_id, consumed)
         self.gen_close_handler = None  # def (task_id)
+        # Fires after a successful controller reconnect (worker_proc
+        # rebinds its batched pushers to the new connection here).
+        self.ctrl_reconnected_handler = None  # def ()
         # Hook used by worker_proc to execute actor calls in-order:
         self.actor_push_handler = None  # def (conn, spec)
         self.actor_batch_handler = None  # def (conn, list[spec]) — one frame
@@ -435,6 +438,7 @@ class Worker:
 
         self.lease_mgr = LeaseManager(self)
         self._shutdown = False
+        self._reconnecting = False  # single-flight controller reconnect
 
     # ------------------------------------------------------------ lifecycle
     def connect(self):
@@ -492,10 +496,70 @@ class Worker:
             h(conn)
 
     def _on_ctrl_close(self, conn):
-        if not self._shutdown and self.mode == _MODE_WORKER:
+        if self._shutdown:
+            return
+        # Controller restart FT (reference RayletNotifyGCSRestart): retry
+        # the same address and re-register instead of dying — running work
+        # (leased pipelines, actor pipes) rides direct connections and
+        # keeps flowing throughout the outage.
+        asyncio.ensure_future(self._a_ctrl_reconnect())
+
+    async def _a_ctrl_reconnect(self):
+        # Single-flight: a failed attempt's abandoned connection fires
+        # on_close too, which would otherwise spawn N concurrent loops.
+        if self._reconnecting:
+            return
+        self._reconnecting = True
+        try:
+            await self._a_ctrl_reconnect_inner()
+        finally:
+            self._reconnecting = False
+
+    async def _a_ctrl_reconnect_inner(self):
+        deadline = time.monotonic() + CONFIG.controller_reconnect_timeout_s
+        logger.warning("worker %s: controller connection lost; retrying",
+                       self.worker_id[:8])
+        while not self._shutdown and time.monotonic() < deadline:
+            conn = None
+            try:
+                conn = await rpc.connect(
+                    *self.controller_addr,
+                    on_push=self._on_ctrl_push,
+                    on_close=self._on_ctrl_close,
+                    timeout=5,
+                )
+                await conn.call(
+                    "register", kind="client", worker_id=self.worker_id,
+                    mode=self.mode, address=self.server_addr, _timeout=10)
+                self.controller = conn
+                h = self.ctrl_reconnected_handler
+                if h is not None:
+                    try:
+                        h()
+                    except Exception:
+                        pass
+                # Re-assert held leases so the restarted controller can
+                # rebuild its resource accounting.
+                self.lease_mgr.reassert()
+                logger.info("worker %s: re-registered with restarted "
+                            "controller", self.worker_id[:8])
+                return
+            except Exception:
+                if conn is not None and not conn.closed:
+                    try:
+                        await conn.close()  # abandoned half-registration
+                    except Exception:
+                        pass
+                await asyncio.sleep(0.5)
+        if self._shutdown:
+            return
+        if self.mode == _MODE_WORKER:
             import os
 
-            os._exit(1)  # cluster went away; worker processes die with it
+            os._exit(1)  # cluster is really gone; workers die with it
+        logger.error("driver: controller gone for %.0fs; subsequent "
+                     "cluster calls will fail",
+                     CONFIG.controller_reconnect_timeout_s)
 
     # --------------------------------------------------------- RPC handlers
     async def _on_request(self, conn, method, a):

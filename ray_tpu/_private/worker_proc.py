@@ -126,6 +126,17 @@ class WorkerProc:
         self.worker.gen_ack_handler = self._on_gen_ack
         self.worker.gen_close_handler = self._on_gen_close
 
+        def _rebind_ctrl_pushers():
+            # Controller reconnected under us: the batched pushers hold the
+            # OLD (dead) connection — rebind them or every later advertise
+            # and task event silently vanishes.
+            self._advertise_pusher = _BatchPusher(
+                self.worker.controller, "register_puts", "items")
+            self._event_pusher = _BatchPusher(
+                self.worker.controller, "task_events", "events")
+
+        self.worker.ctrl_reconnected_handler = _rebind_ctrl_pushers
+
         # Long-lived pool workers serve many lease holders; drop a holder's
         # batched reply pushers when its connection goes away.
         def _prune(conn):

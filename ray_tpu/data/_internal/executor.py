@@ -236,11 +236,87 @@ def _merge_blocks(*blocks):
     return combine_blocks(list(blocks))
 
 
+def _key_fn(key):
+    return key if callable(key) else (
+        lambda r, k=key: r[k] if isinstance(r, dict) else r)
+
+
 @ray_tpu.remote
 def _sort_block_local(block, key, descending):
     rows = BlockAccessor.for_block(block).to_rows()
-    kf = key if callable(key) else (lambda r, k=key: r[k] if isinstance(r, dict) else r)
-    return sorted(rows, key=kf, reverse=descending)
+    return sorted(rows, key=_key_fn(key), reverse=descending)
+
+
+# ---- distributed exchange tasks (reference planner/exchange/
+# sort_task_spec.py + shuffle_task_spec.py: sample -> range-partitioned map
+# tasks -> merge reduce tasks; the driver touches only sampled keys and
+# refs, never rows) --------------------------------------------------------
+@ray_tpu.remote
+def _sample_block_keys(block, key, n_samples):
+    """Uniform key sample of one block (reference SortTaskSpec.sample)."""
+    rows = BlockAccessor.for_block(block).to_rows()
+    if not rows:
+        return []
+    kf = _key_fn(key)
+    rng = random.Random(0xC0FFEE ^ len(rows))
+    picks = rows if len(rows) <= n_samples else rng.sample(rows, n_samples)
+    return [kf(r) for r in picks]
+
+
+@ray_tpu.remote
+def _sort_map(block, key, descending, boundaries):
+    """Map side of the sort exchange: bucket rows by ASCENDING range
+    boundaries, each bucket sorted in final order; one return per range
+    (reference sort_task_spec.map)."""
+    import bisect
+
+    rows = BlockAccessor.for_block(block).to_rows()
+    kf = _key_fn(key)
+    buckets: list[list] = [[] for _ in range(len(boundaries) + 1)]
+    for r in rows:
+        buckets[bisect.bisect_right(boundaries, kf(r))].append(r)
+    for b in buckets:
+        b.sort(key=kf, reverse=descending)
+    if descending:
+        buckets.reverse()  # partition 0 holds the LARGEST keys
+    return buckets if len(buckets) > 1 else buckets[0]
+
+
+@ray_tpu.remote
+def _sort_reduce(key, descending, *parts):
+    """Reduce side: merge N pre-sorted sub-blocks of one key range
+    (reference sort_task_spec.reduce — heap merge, never a full re-sort)."""
+    import heapq
+
+    return list(heapq.merge(*parts, key=_key_fn(key), reverse=descending))
+
+
+@ray_tpu.remote
+def _shuffle_map(block, k, seed):
+    """Map side of the shuffle exchange: permute this block's rows and deal
+    them into k sub-blocks (reference shuffle_task_spec.map)."""
+    rows = BlockAccessor.for_block(block).to_rows()
+    rng = random.Random(seed)
+    rng.shuffle(rows)
+    per = len(rows) // k
+    extra = len(rows) % k
+    parts, off = [], 0
+    for i in range(k):
+        take = per + (1 if i < extra else 0)
+        parts.append(rows[off:off + take])
+        off += take
+    return parts if k > 1 else parts[0]
+
+
+@ray_tpu.remote
+def _shuffle_reduce(seed, *parts):
+    """Reduce side: concatenate one sub-block from every map task and
+    re-permute (reference shuffle_task_spec.reduce)."""
+    rows = []
+    for p in parts:
+        rows.extend(p)
+    random.Random(seed).shuffle(rows)
+    return rows
 
 
 # -------------------------------------------------------------- execution
@@ -281,7 +357,9 @@ def _windowed_submit(items: list, submit) -> list:
             # The brake only engages with work already in flight: progress
             # is always possible even when the store starts above the mark.
             out[i] = submit(items[i])
-            in_flight[out[i]] = i
+            # Multi-return submits (exchange map tasks) track their first
+            # ref: all returns of one task resolve together.
+            in_flight[out[i][0] if isinstance(out[i], list) else out[i]] = i
             i += 1
         if in_flight:
             done, _ = ray_tpu.wait(list(in_flight), num_returns=1, timeout=10)
@@ -462,33 +540,73 @@ def _repartition(refs: list, k: int) -> list:
             for i in range(k) if pieces[i]]
 
 
+def _exchange_maps(refs: list, submit_one, k: int) -> list[list]:
+    """Run map-side exchange tasks (k returns each) with the bounded
+    in-flight window; returns per-partition lists of sub-block refs. The
+    driver handles ONLY refs. submit_one receives (block_index, ref)."""
+    def _submit(pair):
+        prefs = submit_one(*pair)
+        return prefs if isinstance(prefs, list) else [prefs]
+
+    all_parts = _windowed_submit(list(enumerate(refs)), _submit)
+    return [[parts[i] for parts in all_parts] for i in range(k)]
+
+
 def _random_shuffle(refs: list, seed) -> list:
-    rows_refs = refs
-    blocks = ray_tpu.get(rows_refs, timeout=600)
-    all_rows = []
-    for b in blocks:
-        all_rows.extend(BlockAccessor.for_block(b).to_rows())
-    rng = random.Random(seed)
-    rng.shuffle(all_rows)
-    k = max(1, len(refs))
-    n = len(all_rows)
-    out = []
-    per = n // k + (1 if n % k else 0)
-    for s in range(0, n, per or 1):
-        out.append(ray_tpu.put(all_rows[s:s + per]))
-    return out
+    """Distributed shuffle exchange (reference shuffle_task_spec.py): map
+    tasks permute + deal each block into k sub-blocks, reduce tasks merge
+    one sub-block per map and re-permute. Rows never visit the driver."""
+    if not refs:
+        return refs
+    k = len(refs)
+    base = seed if seed is not None else random.randrange(1 << 30)
+    by_part = _exchange_maps(
+        refs,
+        lambda i, r: _shuffle_map.options(num_returns=k).remote(
+            r, k, base ^ (0x9E3779B9 * (i + 1))),
+        k)
+    return _windowed_submit(
+        list(range(k)),
+        lambda i: _shuffle_reduce.remote(base ^ (0x85EBCA6B * (i + 1)),
+                                         *by_part[i]))
 
 
 def _global_sort(refs: list, key, descending) -> list:
-    sorted_refs = [_sort_block_local.remote(r, key, descending) for r in refs]
-    blocks = ray_tpu.get(sorted_refs, timeout=600)
-    import heapq
-
-    kf = key if callable(key) else (lambda r, k=key: r[k] if isinstance(r, dict) else r)
-    merged = list(heapq.merge(*blocks, key=kf, reverse=descending))
-    k = max(1, len(refs))
-    per = len(merged) // k + (1 if len(merged) % k else 0)
-    return [ray_tpu.put(merged[s:s + per]) for s in range(0, len(merged), per or 1)]
+    """Distributed sort exchange (reference sort_task_spec.py): sample keys
+    -> compute k-1 range boundaries -> map tasks range-partition + locally
+    sort -> reduce tasks heap-merge each range. The driver sees sampled
+    KEYS only, never rows — the previous implementation heap-merged every
+    block on the driver and could not scale past driver memory."""
+    if not refs:
+        return refs
+    k = len(refs)
+    if k == 1:
+        return [_sort_block_local.remote(refs[0], key, descending)]
+    # 1. sample (driver holds ~20 keys per block, not rows)
+    samples_per_block = 20
+    key_samples: list = []
+    for sref in _windowed_submit(
+            refs, lambda r: _sample_block_keys.remote(
+                r, key, samples_per_block)):
+        key_samples.extend(ray_tpu.get(sref, timeout=600))
+    key_samples.sort()
+    if not key_samples:
+        return refs
+    # 2. boundaries: k-1 ascending quantile cut points
+    boundaries = [key_samples[min(len(key_samples) - 1,
+                                  (len(key_samples) * (i + 1)) // k)]
+                  for i in range(k - 1)]
+    # 3. map: range-partition + sort each block
+    by_part = _exchange_maps(
+        refs,
+        lambda _i, r: _sort_map.options(num_returns=k).remote(
+            r, key, descending, boundaries),
+        k)
+    # 4. reduce: merge each range (partition order already matches
+    # `descending` — _sort_map reverses bucket order for descending)
+    return _windowed_submit(
+        list(range(k)),
+        lambda i: _sort_reduce.remote(key, descending, *by_part[i]))
 
 
 def _limit(refs: list, n: int) -> list:

@@ -62,6 +62,13 @@ class GenStream:
         self.prompt_len = prompt_len
         self._q: "queue.Queue" = queue.Queue()
         self.finish_reason: Optional[str] = None
+        self.closed = False
+
+    def close(self):
+        """Consumer abandoned the request (client disconnect): the engine
+        retires the slot at its next emit instead of decoding the full
+        max_tokens for nobody (reference: vLLM abort_request)."""
+        self.closed = True
 
     def __iter__(self):
         return self
@@ -401,6 +408,10 @@ class ContinuousEngine:
 
     def _emit(self, slot: int, tok: int):
         st = self._slots[slot]
+        if st.stream.closed:
+            st.stream.finish_reason = "cancelled"
+            self._retire(slot)
+            return
         st.stream._q.put(int(tok))
         st.emitted += 1
         st.remaining -= 1
@@ -547,6 +558,8 @@ class ContinuousEngine:
                 off = 0
                 if firsts and all_np is not None:
                     for slot, _f in firsts:
+                        if self._slots[slot] is None:
+                            continue  # retired by a failed-dispatch path
                         self._next_tok[slot] = int(all_np[slot, 0])
                         self._emit(slot, int(all_np[slot, 0]))
                 if firsts:

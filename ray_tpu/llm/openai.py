@@ -114,13 +114,19 @@ class OpenAIServer:
         engine emits them (rides the core streaming-generator transport
         through the replica/proxy)."""
         def gen():
-            for tok in stream:
+            try:
+                for tok in stream:
+                    yield self._completion_body(
+                        req_id, self.tok.decode([tok]), [tok], None, chat,
+                        stream_delta=True)
                 yield self._completion_body(
-                    req_id, self.tok.decode([tok]), [tok], None, chat,
+                    req_id, "", [], stream.finish_reason or "length", chat,
                     stream_delta=True)
-            yield self._completion_body(
-                req_id, "", [], stream.finish_reason or "length", chat,
-                stream_delta=True)
+            finally:
+                # Consumer gone (client disconnect propagates as
+                # GeneratorExit through the serve streaming path): free the
+                # engine slot instead of decoding to max_tokens for nobody.
+                stream.close()
         return gen()
 
     def check_health(self):

@@ -22,13 +22,15 @@ def main():
     p.add_argument("--num-tpus", type=float, default=None)
     p.add_argument("--resources", default="{}")
     p.add_argument("--session-dir", required=True)
+    p.add_argument("--session", default=None,
+                   help="restart into an existing session id (controller FT)")
     args = p.parse_args()
 
     from ray_tpu._private.bootstrap import HeadNode
 
     head = HeadNode(num_cpus=args.num_cpus, num_tpus=args.num_tpus,
                     resources=json.loads(args.resources),
-                    host=args.host, port=args.port)
+                    host=args.host, port=args.port, session_id=args.session)
     addr = head.start()
     os.makedirs(args.session_dir, exist_ok=True)
     with open(os.path.join(args.session_dir, "head.json"), "w") as f:

@@ -184,12 +184,26 @@ class Proxy:
                 else:
                     data = json.dumps(item)
                 await resp.write(f"data: {data}\n\n".encode())
+            await resp.write(b"data: [DONE]\n\n")
+            await resp.write_eof()
         except Exception as e:
-            logger.error("serve proxy stream error: %r", e)
-            await resp.write(
-                f"data: {json.dumps({'error': repr(e)})}\n\n".encode())
-        await resp.write(b"data: [DONE]\n\n")
-        await resp.write_eof()
+            # Client disconnects raise from resp.write: the tail writes
+            # must not raise uncaught (they'd leak the stream below).
+            logger.debug("serve proxy stream ended early: %r", e)
+            try:
+                await resp.write(
+                    f"data: {json.dumps({'error': repr(e)})}\n\n".encode())
+                await resp.write(b"data: [DONE]\n\n")
+                await resp.write_eof()
+            except Exception:
+                pass
+        finally:
+            # Drop the generator NOW: its finalizer sends gen_close to the
+            # replica, whose streaming wrapper closes the user iterator,
+            # which releases the engine slot — without this, an abandoned
+            # LLM stream keeps decoding to max_tokens for nobody.
+            del it
+            del gen
         return resp
 
     def _to_response(self, result):

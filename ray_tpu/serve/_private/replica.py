@@ -132,12 +132,23 @@ class Replica:
                 loop = asyncio.get_event_loop()
                 it = iter(out)
                 sentinel = object()
-                while True:
-                    item = await loop.run_in_executor(
-                        self._stream_pool, lambda: next(it, sentinel))
-                    if item is sentinel:
-                        break
-                    yield item
+                try:
+                    while True:
+                        item = await loop.run_in_executor(
+                            self._stream_pool, lambda: next(it, sentinel))
+                        if item is sentinel:
+                            break
+                        yield item
+                finally:
+                    # Abandonment (gen_close -> aclose of this generator)
+                    # must run the user iterator's finally blocks so
+                    # engines can release per-request resources.
+                    close = getattr(it, "close", None)
+                    if close is not None:
+                        try:
+                            close()
+                        except Exception:
+                            pass
             else:
                 yield out  # single-item "stream"
         finally:

@@ -206,3 +206,81 @@ def test_store_backpressure_policy(shutdown_only):
     ex._bp_cache.update(t=0.0)
     assert ex._store_backpressured() is True
     del big
+
+
+def test_distributed_sort_exchange(ray_start_2cpu):
+    """Sample-based range-partitioned sort (reference sort_task_spec.py):
+    many blocks, skewed values, ascending + descending, dict keys — and
+    the driver must never materialize row payloads."""
+    import random as _random
+
+    import ray_tpu.data._internal.executor as ex
+
+    rng = _random.Random(3)
+    vals = [rng.randrange(10_000) for _ in range(400)] + [7] * 50
+    ds = rd.from_items(vals, parallelism=8).sort()
+    out = ds.take_all()
+    assert out == sorted(vals)
+    # descending
+    d = rd.from_items(vals, parallelism=8).sort(descending=True).take_all()
+    assert d == sorted(vals, reverse=True)
+    # dict rows with a key column
+    recs = [{"k": rng.randrange(100), "v": i} for i in range(200)]
+    s = rd.from_items(recs, parallelism=4).sort(key=lambda r: r["k"])
+    ks = [r["k"] for r in s.take_all()]
+    assert ks == sorted(ks)
+    # driver isolation: ray_tpu.get during the exchange must only carry
+    # key samples / counts, never row payloads
+    big = rd.from_items(list(range(2000)), parallelism=8)
+    real_get = ray_tpu.get
+    seen = []
+
+    def spy_get(refs, timeout=None):
+        out = real_get(refs, timeout=timeout)
+        for o in out if isinstance(out, list) else [out]:
+            if isinstance(o, list) and len(o) > 100:
+                seen.append(len(o))
+        return out
+
+    ex.ray_tpu.get = spy_get
+    try:
+        sorted_ds = big.sort()
+        blocks = sorted_ds._block_refs()
+    finally:
+        ex.ray_tpu.get = real_get
+    assert not seen, f"driver pulled row payloads during sort: {seen}"
+    rows = []
+    for b in ray_tpu.get(blocks, timeout=600):
+        rows.extend(b)
+    assert rows == list(range(2000))
+
+
+def test_distributed_shuffle_exchange(ray_start_2cpu):
+    """Shuffle as a map/reduce exchange: permutation correctness, seed
+    determinism, and no driver row materialization."""
+    import ray_tpu.data._internal.executor as ex
+
+    vals = list(range(500))
+    a = rd.from_items(vals, parallelism=8).random_shuffle(seed=11).take_all()
+    b = rd.from_items(vals, parallelism=8).random_shuffle(seed=11).take_all()
+    c = rd.from_items(vals, parallelism=8).random_shuffle(seed=12).take_all()
+    assert sorted(a) == vals and sorted(c) == vals
+    assert a == b  # same seed -> same permutation
+    assert a != c  # different seed -> different permutation
+    assert a != vals  # actually shuffled
+    real_get = ray_tpu.get
+    seen = []
+
+    def spy_get(refs, timeout=None):
+        out = real_get(refs, timeout=timeout)
+        for o in out if isinstance(out, list) else [out]:
+            if isinstance(o, list) and len(o) > 100:
+                seen.append(len(o))
+        return out
+
+    ex.ray_tpu.get = spy_get
+    try:
+        rd.from_items(vals, parallelism=8).random_shuffle(seed=5)._block_refs()
+    finally:
+        ex.ray_tpu.get = real_get
+    assert not seen, f"driver pulled row payloads during shuffle: {seen}"
