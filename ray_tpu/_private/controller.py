@@ -149,6 +149,9 @@ class Controller:
         # (owner, lease_entry, expiry): reasserted leases whose node agent
         # hasn't re-registered yet (controller restart FT).
         self._parked_reasserts: list[tuple] = []
+        # task_id -> (node_id, raw resources): pre-restart in-flight tasks
+        # whose capacity was charged from an agent's inventory report.
+        self._reconciled_busy: dict[str, tuple] = {}
         # worker_ids that ever hosted an actor instance: the fate-sharing
         # reaper must recognize an actor owner even after its entry's
         # worker_id was cleared by the death bookkeeping.
@@ -191,6 +194,11 @@ class Controller:
                     "message": f"actor {aid[:12]} did not survive the "
                                f"controller restart (worker and owner gone)"})
                 ent.death_cause = [h, *bufs]
+                if ent.name:
+                    # Free the name like every other death path does
+                    # (_bury_actor), or get_actor(name) resolves to a corpse.
+                    self.named_actors.pop((ent.namespace, ent.name), None)
+                self._mark_dirty()
                 self._publish("actor", {"actor_id": aid, "state": "DEAD"})
             # Either way: wake get_actor_info callers parked on RECOVERING.
             for fut in ent.waiters:
@@ -384,8 +392,13 @@ class Controller:
                             aid[:8], w["worker_id"][:8])
         elif w.get("state") == "busy" and held:
             # A controller-dispatched task still running; charge its
-            # resources so the scheduler doesn't oversubscribe the node.
+            # resources so the scheduler doesn't oversubscribe the node,
+            # and remember the charge so its task_done (or the node's
+            # death) releases it — this controller never dispatched the
+            # task, so the normal release path can't.
             node.available.subtract(ResourceSet(_raw=held))
+            if w.get("task_id"):
+                self._reconciled_busy[w["task_id"]] = (nid, dict(held))
 
     async def _p_reassert_leases(self, conn, a):
         """An owner re-declares leases it held across a controller restart
@@ -672,6 +685,16 @@ class Controller:
         task_id = a["task_id"]
         self.cancelled.pop(task_id, None)  # completed: stale cancel marker must
         # not kill a later lineage reconstruction of the same task_id
+        rec = self._reconciled_busy.pop(task_id, None)
+        if rec is not None:
+            # A pre-restart in-flight task finishing: release the capacity
+            # the agent's inventory report charged (this controller never
+            # dispatched it, so the normal release path can't fire).
+            nid, raw = rec
+            node = self.nodes.get(nid)
+            if node is not None and node.alive:
+                node.available.add(ResourceSet(_raw=raw))
+                self._kick()
         info = self.dispatched.pop(task_id, None)
         if info is None and a.get("spec") is None and not a.get("_replayed"):
             # Completion raced ahead of the dispatch reply: park it for
@@ -1678,6 +1701,9 @@ class Controller:
             return
         node.alive = False
         self.node_conns.pop(nid, None)
+        self._reconciled_busy = {
+            t: (n, r) for t, (n, r) in self._reconciled_busy.items()
+            if n != nid}
         logger.warning("node %s died", nid[:8])
         self._publish("node", {"node_id": nid, "alive": False})
         # Invalidate leases whose worker lived there.

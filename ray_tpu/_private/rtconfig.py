@@ -21,7 +21,13 @@ def _flag(name: str, typ: type, default: Any) -> None:
 
 # --- core timings / limits -------------------------------------------------
 _flag("heartbeat_interval_s", float, 0.5)
-_flag("num_heartbeats_timeout", int, 6)  # node dead after N missed beats
+# Node dead after N missed beats. 20 (=10s) rather than a twitchy few
+# seconds: an agent spawning a burst of worker processes on a loaded host
+# can starve its event loop for several seconds, and declaring it dead
+# kills every actor it hosts (reference health checks tolerate ~30s:
+# health_check_timeout_ms + failure_threshold). TCP disconnects still
+# detect true death instantly via the connection-close path.
+_flag("num_heartbeats_timeout", int, 20)
 _flag("task_retry_delay_s", float, 0.05)
 _flag("default_max_task_retries", int, 3)
 _flag("default_max_actor_restarts", int, 0)

@@ -1742,8 +1742,14 @@ class _ActorPipe:
             try:
                 info = await self.w._a_resolve_actor(self.actor_id)
                 if info.get("address") is None:
-                    raise exc.ActorUnavailableError(
-                        f"actor {self.actor_id[:12]} has no address")
+                    # Still PENDING (creation queued/scheduling — on a
+                    # loaded cluster a big actor wave can take minutes):
+                    # calls QUEUE until the actor lands (reference actor
+                    # task submitter buffers until the actor is ready).
+                    # A dead actor raises from _a_resolve_actor instead.
+                    self.w._actor_info.pop(self.actor_id, None)
+                    await asyncio.sleep(0.5)
+                    continue
                 conn = await rpc.connect(
                     *info["address"], on_push=self._on_push,
                     on_close=self._on_close, timeout=10)

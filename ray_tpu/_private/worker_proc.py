@@ -302,6 +302,13 @@ class WorkerProc:
         methods in declared concurrency groups use per-group thread pools;
         default actors execute inline in arrival order (reference
         concurrency_group_manager.h + fiber.h for async actors)."""
+        if spec.method_name == "__rt_dag_loop__":
+            # Compiled-graph execution loop attached to this EXISTING actor
+            # (reference compiled_dag_node: bound actors host channel
+            # loops). Runs on its OWN thread so normal method calls keep
+            # flowing; the reply resolves when the DAG tears down.
+            self._start_dag_loop(spec, reply_slot)
+            return
         ent = self._method_cache.get(spec.method_name)
         if ent is None and self.actor_instance is not None:
             m = getattr(self.actor_instance, spec.method_name, None)
@@ -338,6 +345,27 @@ class WorkerProc:
         else:
             reply = self._execute_actor_task(spec, conn)
             self._reply_value(reply_slot, spec.task_id, reply)
+
+    def _start_dag_loop(self, spec: TaskSpec, reply_slot):
+        """Spawn the compiled-DAG stage loop thread for this actor."""
+        def _run():
+            error_blob = None
+            value = None
+            try:
+                from ray_tpu.dag import run_stage_loop
+
+                (desc,), _ = self.worker.decode_args(spec.args, spec.kwargs)
+                method = getattr(self.actor_instance, desc["method"])
+                value = run_stage_loop(
+                    method, desc["in_specs"], desc["out_names"],
+                    desc.get("kwargs") or {}, desc["size"])
+            except BaseException as e:  # noqa: BLE001
+                error_blob = self._make_error_blob(spec, e)
+            reply = self._finish_actor_task(spec, value, error_blob)
+            self._reply_value(reply_slot, spec.task_id, reply)
+
+        threading.Thread(target=_run, daemon=True,
+                         name="rt-dag-loop").start()
 
     def _group_pool(self, group: str):
         """Thread pool for one declared concurrency group (reference

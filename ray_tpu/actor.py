@@ -58,6 +58,14 @@ class ActorMethod:
         )
         return refs[0] if self._num_returns == 1 else refs
 
+    def bind(self, *args, **kwargs):
+        """Compiled-graph binding of this EXISTING actor's method
+        (reference actor.method.bind -> dag.DAGNode); compile() attaches a
+        channel execution loop to the actor."""
+        from ray_tpu.dag import ActorMethodNode
+
+        return ActorMethodNode(self._handle, self._name, args, kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(f"Actor method {self._name!r} must be called with .remote().")
 

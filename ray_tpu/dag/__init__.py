@@ -1,33 +1,39 @@
-"""Compiled graphs: pre-wired actor pipelines over shm channels.
+"""Compiled graphs: pre-wired execution over shm channels.
 
 Parity target: reference python/ray/dag/compiled_dag_node.py:805
 (experimental_compile — turn a bound DAG into persistent per-actor
 execution loops connected by mutable shm channels, removing ALL per-call
 RPC/scheduling from the steady state) + experimental/channel/.
 
-Surface: function DAGs built with `.bind()`:
+Surface (general DAGs: fan-in, fan-out, multi-output, actor methods):
 
     with InputNode() as inp:
-        dag = postprocess.bind(model_forward.bind(inp))
-    cdag = compile(dag)           # stage actors + channels come up once
-    out = cdag.execute(x)         # shm write -> pipeline -> shm read
+        a = f.bind(inp)                     # function stage
+        b = my_actor.work.bind(inp)         # EXISTING actor's method stage
+        dag = MultiOutputNode([g.bind(a, b), h.bind(a)])   # fan-in + fan-out
+    cdag = compile(dag)
+    out1, out2 = cdag.execute(x)            # shm in -> graph -> shm out
     cdag.teardown()
 
-Each DAG node becomes a dedicated stage ACTOR running a channel loop: the
-driver writes the input channel and reads the output channel; intermediate
-hops never touch the control plane. (The reference compiles existing-actor
-method DAGs; stage actors are this round's functional equivalent for the
-function-DAG surface.)
+Every EDGE gets its own SPSC shm channel (a producer consumed by N
+downstream nodes writes N channels — the fan-out mechanism; a node with
+N upstream DAG args reads N channels — fan-in). Function nodes run in
+dedicated stage actors; actor-method nodes attach an execution-loop
+THREAD to the existing actor (reference: compiled loops on the bound
+actors), so the steady state is channel reads/writes only — no RPC.
 """
 
 from __future__ import annotations
 
+import threading
 import uuid
 from typing import Any, Optional
 
 import ray_tpu
 from ray_tpu.experimental.channel import Channel
 from ray_tpu.workflow import DAGNode
+
+_SHUTDOWN = "__rt_dag_stop__"
 
 
 class InputNode:
@@ -40,36 +46,22 @@ class InputNode:
         return False
 
 
-class _StageActor:
-    """Hosts one compiled stage: a loop pulling from the in-channel,
-    applying the stage function, pushing to the out-channel."""
+class MultiOutputNode:
+    """Marks several DAG leaves as the compiled graph's outputs
+    (reference dag.MultiOutputNode); execute() returns a list."""
 
-    def __init__(self, fn, in_name: str, out_name: str, size: int):
-        self.fn = fn
-        self.in_ch = Channel(in_name, size, _create=False)
-        self.out_ch = Channel(out_name, size, _create=False)
-        self._stop = False
+    def __init__(self, nodes: list):
+        self.nodes = list(nodes)
 
-    def run_loop(self):
-        while True:
-            try:
-                item = self.in_ch.read(timeout=0.5)
-            except TimeoutError:
-                if self._stop:
-                    return True
-                continue
-            if item is _SHUTDOWN or (isinstance(item, str) and item == "__rt_dag_stop__"):
-                self.out_ch.write("__rt_dag_stop__")
-                return True
-            try:
-                out = self.fn(item)
-            except Exception as e:  # propagate downstream as an error value
-                out = _StageError(repr(e))
-            self.out_ch.write(out)
 
-    def stop(self):
-        self._stop = True
-        return True
+class ActorMethodNode(DAGNode):
+    """A bound method of an EXISTING actor (reference: actor.method.bind).
+    Created by ActorMethod.bind()."""
+
+    def __init__(self, actor_handle, method_name: str, args, kwargs):
+        super().__init__(None, args, kwargs, method_name)
+        self.actor_handle = actor_handle
+        self.method_name = method_name
 
 
 class _StageError:
@@ -77,64 +69,192 @@ class _StageError:
         self.msg = msg
 
 
-_SHUTDOWN = "__rt_dag_stop__"
+def run_stage_loop(call, in_specs: list, out_names: list, kwargs: dict,
+                   size: int):
+    """The compiled execution loop shared by function-stage actors and
+    actor-method loop threads: read every channel input, apply, write
+    every out edge. Stop tokens and upstream stage errors pass through."""
+    in_chs = [(i, Channel(nm, size, _create=False))
+              for i, (kind, nm) in enumerate(in_specs) if kind == "ch"]
+    literals = [v if kind == "lit" else None for kind, v in in_specs]
+    out_chs = [Channel(nm, size, _create=False) for nm in out_names]
+    while True:
+        args = list(literals)
+        stop = False
+        err: Optional[_StageError] = None
+        for i, ch in in_chs:
+            item = ch.read(timeout=None)
+            if isinstance(item, str) and item == _SHUTDOWN:
+                stop = True
+            elif isinstance(item, _StageError) and err is None:
+                err = item
+            else:
+                args[i] = item
+        if stop:
+            for ch in out_chs:
+                ch.write(_SHUTDOWN)
+            return True
+        if err is not None:
+            out = err  # propagate the FIRST upstream error
+        else:
+            try:
+                out = call(*args, **kwargs)
+            except Exception as e:
+                out = _StageError(repr(e))
+        for ch in out_chs:
+            ch.write(out)
 
 
-def _linearize(dag: DAGNode) -> list:
-    """Flatten a single-path function DAG (each node has exactly one
-    DAGNode/InputNode arg) into stage order."""
-    chain = []
-    node: Any = dag
-    while isinstance(node, DAGNode):
-        dag_args = [a for a in list(node.args) + list(node.kwargs.values())
-                    if isinstance(a, (DAGNode, InputNode))]
-        if len(dag_args) != 1:
-            raise ValueError(
-                "compiled DAGs support linear function pipelines in this "
-                "round (exactly one upstream per node)")
-        chain.append(node)
-        node = dag_args[0]
-    if not isinstance(node, InputNode):
-        raise ValueError("the pipeline root must consume InputNode")
-    return list(reversed(chain))
+class _StageActor:
+    """Hosts one compiled FUNCTION stage."""
+
+    def __init__(self, fn, in_specs: list, out_names: list, kwargs: dict,
+                 size: int):
+        self.fn = fn
+        self.in_specs = in_specs
+        self.out_names = out_names
+        self.kwargs = kwargs
+        self.size = size
+
+    def run_loop(self):
+        return run_stage_loop(self.fn, self.in_specs, self.out_names,
+                              self.kwargs, self.size)
 
 
 class CompiledDAG:
-    def __init__(self, dag: DAGNode, *, channel_size: int = 1 << 20):
-        chain = _linearize(dag)
+    def __init__(self, dag, *, channel_size: int = 1 << 20):
+        outputs = dag.nodes if isinstance(dag, MultiOutputNode) else [dag]
         tag = uuid.uuid4().hex[:8]
-        n = len(chain)
-        # channels: driver -> s0 -> s1 -> ... -> driver
-        names = [f"{tag}_{i}" for i in range(n + 1)]
-        self._channels = [Channel(nm, channel_size) for nm in names]
-        self._in = self._channels[0]
-        self._out = self._channels[-1]
+        self._size = channel_size
+
+        # ---- discover nodes + edges (consumer counts drive fan-out)
+        nodes: list[DAGNode] = []
+        seen: dict[int, DAGNode] = {}
+
+        def visit(n):
+            if isinstance(n, InputNode):
+                return
+            if id(n) in seen:
+                return
+            seen[id(n)] = n
+            for a in list(n.args) + list(n.kwargs.values()):
+                if isinstance(a, (DAGNode, InputNode)):
+                    visit(a)
+            nodes.append(n)  # post-order = topological
+
+        for out in outputs:
+            if not isinstance(out, DAGNode):
+                raise ValueError("DAG outputs must be bound nodes")
+            visit(out)
+
+        # ---- one channel per EDGE
+        self._channels: list[Channel] = []
+        counter = [0]
+
+        def new_channel() -> Channel:
+            ch = Channel(f"{tag}_{counter[0]}", channel_size)
+            counter[0] += 1
+            self._channels.append(ch)
+            return ch
+
+        # producer node -> list of its out-edge channels
+        out_edges: dict[int, list] = {id(n): [] for n in nodes}
+        self._input_edges: list[Channel] = []  # driver-written
+        # per node: in_specs aligned with positional args
+        in_specs: dict[int, list] = {}
+        kw_literals: dict[int, dict] = {}
+        for n in nodes:
+            specs = []
+            for a in n.args:
+                if isinstance(a, InputNode):
+                    ch = new_channel()
+                    self._input_edges.append(ch)
+                    specs.append(("ch", ch.name))
+                elif isinstance(a, DAGNode):
+                    ch = new_channel()
+                    out_edges[id(a)].append(ch)
+                    specs.append(("ch", ch.name))
+                else:
+                    specs.append(("lit", a))
+            kws = {}
+            for k, a in n.kwargs.items():
+                if isinstance(a, (DAGNode, InputNode)):
+                    raise ValueError(
+                        "DAG args must be positional (kwargs are literals)")
+                kws[k] = a
+            if not any(kind == "ch" for kind, _v in specs):
+                # A node with no channel inputs would free-run decoupled
+                # from execute() and its loop could never be stopped by
+                # teardown (stop tokens flow along edges).
+                raise ValueError(
+                    f"DAG node {n.name!r} has no upstream: every node must "
+                    f"consume InputNode or another node")
+            in_specs[id(n)] = specs
+            kw_literals[id(n)] = kws
+        # output edges: driver-read
+        self._output_edges: list[Channel] = []
+        for out in outputs:
+            ch = new_channel()
+            out_edges[id(out)].append(ch)
+            self._output_edges.append(ch)
+
+        # ---- launch stages
         stage_cls = ray_tpu.remote(num_cpus=1, max_concurrency=2)(_StageActor)
-        self._actors = []
+        self._actors = []       # our function-stage actors (killed on teardown)
         self._loops = []
-        for i, node in enumerate(chain):
-            fn = getattr(node.fn, "_fn", node.fn)
-            a = stage_cls.remote(fn, names[i], names[i + 1], channel_size)
-            self._actors.append(a)
-            self._loops.append(a.run_loop.remote())
+        self._actor_loop_refs = []  # existing-actor loop futures
+        from ray_tpu._private.worker import global_worker
+
+        for n in nodes:
+            outs = [c.name for c in out_edges[id(n)]]
+            if isinstance(n, ActorMethodNode):
+                # Attach the loop to the EXISTING actor: a hidden actor task
+                # the worker runtime runs on a dedicated thread (reference
+                # compiled_dag_node attaches exec loops to bound actors).
+                w = global_worker()
+                refs = w.submit_actor_task(
+                    n.actor_handle._actor_id, "__rt_dag_loop__",
+                    ({"method": n.method_name,
+                      "in_specs": in_specs[id(n)],
+                      "out_names": outs,
+                      "kwargs": kw_literals[id(n)],
+                      "size": channel_size},), {})
+                self._actor_loop_refs.append(refs[0])
+            else:
+                fn = getattr(n.fn, "_fn", n.fn)
+                a = stage_cls.remote(fn, in_specs[id(n)], outs,
+                                     kw_literals[id(n)], channel_size)
+                self._actors.append(a)
+                self._loops.append(a.run_loop.remote())
+        self._multi = isinstance(dag, MultiOutputNode)
         self._dead = False
 
     def execute(self, value, timeout: float = 60.0):
-        """One pipelined invocation: shm in, shm out — no per-call RPC."""
+        """One invocation: shm writes in, shm reads out — no per-call RPC.
+        Returns the single output value, or a list for MultiOutputNode."""
         assert not self._dead, "compiled DAG was torn down"
-        self._in.write(value, timeout=timeout)
-        out = self._out.read(timeout=timeout)
-        if isinstance(out, _StageError):
-            raise RuntimeError(f"compiled DAG stage failed: {out.msg}")
-        return out
+        for ch in self._input_edges:
+            ch.write(value, timeout=timeout)
+        outs = [ch.read(timeout=timeout) for ch in self._output_edges]
+        for o in outs:
+            if isinstance(o, _StageError):
+                raise RuntimeError(f"compiled DAG stage failed: {o.msg}")
+        return outs if self._multi else outs[0]
 
     def teardown(self):
         if self._dead:
             return
         self._dead = True
         try:
-            self._in.write(_SHUTDOWN, timeout=5)
-            ray_tpu.get(self._loops, timeout=30)
+            for ch in self._input_edges:
+                ch.write(_SHUTDOWN, timeout=5)
+            # drain the stop tokens so loops can finish their final writes
+            for ch in self._output_edges:
+                try:
+                    ch.read(timeout=5)
+                except Exception:
+                    pass
+            ray_tpu.get(self._loops + self._actor_loop_refs, timeout=30)
         except Exception:
             pass
         for a in self._actors:
@@ -146,5 +266,5 @@ class CompiledDAG:
             ch.close(unlink=True)
 
 
-def compile(dag: DAGNode, **kw) -> CompiledDAG:  # noqa: A001 - reference name
+def compile(dag, **kw) -> CompiledDAG:  # noqa: A001 - reference name
     return CompiledDAG(dag, **kw)

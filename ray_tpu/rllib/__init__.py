@@ -14,26 +14,45 @@ from ray_tpu.rllib.algorithm import (
     PPO,
     PPOConfig,
 )
+from ray_tpu.rllib.dqn import DQN, DQNConfig, DQNEnvRunner, DQNLearner, DQNLearnerConfig
 from ray_tpu.rllib.env import ENV_REGISTRY, CartPoleVecEnv, make_vec_env
 from ray_tpu.rllib.impala import IMPALA, IMPALAConfig, IMPALALearner, IMPALALearnerConfig
 from ray_tpu.rllib.env_runner import SingleAgentEnvRunner
 from ray_tpu.rllib.learner import PPOLearner, PPOLearnerConfig, compute_gae
+from ray_tpu.rllib.multi_agent import (
+    MultiAgentCartPole,
+    MultiAgentEnvRunner,
+    MultiAgentPPO,
+    MultiAgentPPOConfig,
+)
+from ray_tpu.rllib.replay import PrioritizedReplayBuffer, ReplayBufferGroup
 from ray_tpu.rllib.rl_module import RLModule, RLModuleSpec
 
 __all__ = [
     "Algorithm",
     "AlgorithmConfig",
     "CartPoleVecEnv",
+    "DQN",
+    "DQNConfig",
+    "DQNEnvRunner",
+    "DQNLearner",
+    "DQNLearnerConfig",
     "ENV_REGISTRY",
     "EnvRunnerGroup",
     "IMPALA",
     "IMPALAConfig",
     "IMPALALearner",
     "IMPALALearnerConfig",
+    "MultiAgentCartPole",
+    "MultiAgentEnvRunner",
+    "MultiAgentPPO",
+    "MultiAgentPPOConfig",
     "PPO",
     "PPOConfig",
     "PPOLearner",
     "PPOLearnerConfig",
+    "PrioritizedReplayBuffer",
+    "ReplayBufferGroup",
     "RLModule",
     "RLModuleSpec",
     "SingleAgentEnvRunner",

@@ -1,0 +1,266 @@
+"""DQN: off-policy Q-learning with double-Q targets + prioritized replay.
+
+Parity target: reference rllib/algorithms/dqn/dqn.py (new API stack:
+EnvRunners collect with epsilon-greedy, transitions land in a prioritized
+replay buffer, the learner samples minibatches, double-DQN targets, target
+net synced every `target_network_update_freq` steps, TD errors fed back as
+priorities). TPU-native: the whole update (forward, huber loss, Adam,
+target sync) is ONE jitted function; the buffer fleet stays on CPU hosts.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field, replace
+from typing import Any, Optional
+
+import numpy as np
+
+import ray_tpu
+from ray_tpu.rllib.algorithm import Algorithm, AlgorithmConfig
+from ray_tpu.rllib.env import make_vec_env
+from ray_tpu.rllib.replay import ReplayBufferGroup
+from ray_tpu.rllib.rl_module import RLModuleSpec
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class QNet(nn.Module):
+    spec: RLModuleSpec
+
+    @nn.compact
+    def __call__(self, obs):
+        x = obs
+        for i, h in enumerate(self.spec.hidden):
+            x = nn.relu(nn.Dense(h, name=f"fc{i}")(x))
+        return nn.Dense(self.spec.action_dim, name="q")(x)
+
+
+@dataclass
+class DQNLearnerConfig:
+    lr: float = 1e-3
+    gamma: float = 0.99
+    target_update_freq: int = 100  # learner updates between target syncs
+    huber_delta: float = 1.0
+
+
+class DQNLearner:
+    """Double-DQN learner: one jitted update step (reference
+    dqn_rainbow_torch_learner compute_loss_for_module)."""
+
+    def __init__(self, spec: RLModuleSpec, cfg: DQNLearnerConfig, seed=0):
+        self.cfg = cfg
+        self.net = QNet(spec)
+        dummy = jnp.zeros((1, spec.observation_dim), jnp.float32)
+        self.params = self.net.init(jax.random.PRNGKey(seed), dummy)
+        self.target_params = jax.tree.map(lambda x: x, self.params)
+        self.opt = optax.adam(cfg.lr)
+        self.opt_state = self.opt.init(self.params)
+        self._updates = 0
+
+        def loss_fn(params, target_params, batch, weights):
+            q = self.net.apply(params, batch["obs"])  # [B, A]
+            q_sa = jnp.take_along_axis(
+                q, batch["actions"][:, None], axis=-1)[:, 0]
+            # Double DQN: online net picks a', target net evaluates it.
+            next_q_online = self.net.apply(params, batch["next_obs"])
+            next_a = jnp.argmax(next_q_online, axis=-1)
+            next_q_target = self.net.apply(target_params, batch["next_obs"])
+            next_v = jnp.take_along_axis(
+                next_q_target, next_a[:, None], axis=-1)[:, 0]
+            target = batch["rewards"] + cfg.gamma * (
+                1.0 - batch["dones"]) * next_v
+            td = q_sa - jax.lax.stop_gradient(target)
+            loss = jnp.mean(weights * optax.huber_loss(
+                td, delta=cfg.huber_delta))
+            return loss, td
+
+        def update(params, target_params, opt_state, batch, weights):
+            (loss, td), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target_params, batch, weights)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss, td
+
+        self._update = jax.jit(update)
+
+    def update(self, batch: dict, weights: np.ndarray):
+        """-> (stats, |td| per sample for priority feedback)."""
+        jbatch = {
+            "obs": jnp.asarray(batch["obs"], jnp.float32),
+            "actions": jnp.asarray(batch["actions"], jnp.int32),
+            "rewards": jnp.asarray(batch["rewards"], jnp.float32),
+            "next_obs": jnp.asarray(batch["next_obs"], jnp.float32),
+            "dones": jnp.asarray(batch["dones"], jnp.float32),
+        }
+        self.params, self.opt_state, loss, td = self._update(
+            self.params, self.target_params, self.opt_state, jbatch,
+            jnp.asarray(weights, jnp.float32))
+        self._updates += 1
+        if self._updates % self.cfg.target_update_freq == 0:
+            self.target_params = jax.tree.map(lambda x: x, self.params)
+        return ({"loss": float(loss), "num_updates": self._updates},
+                np.abs(np.asarray(td)))
+
+    def get_weights(self):
+        return self.params
+
+
+class DQNEnvRunner:
+    """Epsilon-greedy rollout actor emitting TRANSITIONS (off-policy: the
+    batch is (s, a, r, s', done) tuples, not trajectories). Reference
+    single_agent_env_runner with the epsilon-greedy exploration connector."""
+
+    def __init__(self, env_name, num_envs: int, spec: RLModuleSpec, seed=0):
+        self.env = make_vec_env(env_name, num_envs, seed=seed)
+        self.net = QNet(spec)
+        self.params = None
+        self._rng = np.random.RandomState(seed)
+        self._q = jax.jit(self.net.apply)
+        self.obs = self.env.obs()
+        self._ep_ret = np.zeros(num_envs, np.float64)
+        self._done_returns: list[float] = []
+
+    def set_weights(self, weights):
+        self.params = weights
+        return True
+
+    def sample(self, num_steps: int, epsilon: float) -> dict:
+        assert self.params is not None
+        N = self.env.num_envs
+        obs_b, act_b, rew_b, next_b, done_b = [], [], [], [], []
+        for _ in range(num_steps):
+            q = np.asarray(self._q(self.params, jnp.asarray(self.obs)))
+            greedy = q.argmax(axis=-1)
+            rand = self._rng.randint(0, q.shape[-1], size=N)
+            explore = self._rng.random_sample(N) < epsilon
+            action = np.where(explore, rand, greedy).astype(np.int64)
+            obs_b.append(self.obs.copy())
+            self.obs, rewards, dones = self.env.step(action)
+            act_b.append(action)
+            rew_b.append(rewards)
+            next_b.append(self.obs.copy())
+            done_b.append(dones)
+            self._ep_ret += rewards
+            fin = dones.astype(bool)
+            if fin.any():
+                self._done_returns.extend(self._ep_ret[fin].tolist())
+                self._ep_ret[fin] = 0.0
+        returns, self._done_returns = self._done_returns, []
+        return {
+            "obs": np.concatenate(obs_b).astype(np.float32),
+            "actions": np.concatenate(act_b).astype(np.int32),
+            "rewards": np.concatenate(rew_b).astype(np.float32),
+            "next_obs": np.concatenate(next_b).astype(np.float32),
+            "dones": np.concatenate(done_b).astype(np.float32),
+            "episode_returns": returns,
+        }
+
+
+@dataclass
+class DQNConfig(AlgorithmConfig):
+    learner: DQNLearnerConfig = field(default_factory=DQNLearnerConfig)
+    replay_capacity: int = 50_000
+    replay_shards: int = 1
+    replay_alpha: float = 0.6
+    replay_beta: float = 0.4
+    train_batch_size: int = 64
+    num_learner_updates: int = 16  # sgd steps per train() iteration
+    epsilon_start: float = 1.0
+    epsilon_end: float = 0.05
+    epsilon_decay_iters: int = 20
+    learning_starts: int = 500  # min transitions before updates begin
+
+    def training(self, *, lr: Optional[float] = None,
+                 gamma: Optional[float] = None,
+                 target_update_freq: Optional[int] = None,
+                 train_batch_size: Optional[int] = None,
+                 num_learner_updates: Optional[int] = None) -> "DQNConfig":
+        kw = {k: v for k, v in dict(
+            lr=lr, gamma=gamma,
+            target_update_freq=target_update_freq).items() if v is not None}
+        self.learner = replace(self.learner, **kw)
+        if train_batch_size is not None:
+            self.train_batch_size = train_batch_size
+        if num_learner_updates is not None:
+            self.num_learner_updates = num_learner_updates
+        return self
+
+    def build(self) -> "DQN":
+        return DQN(copy.deepcopy(self))
+
+
+class DQN(Algorithm):
+    def __init__(self, config: DQNConfig):
+        super().__init__(config)
+        probe = make_vec_env(config.env, 1, seed=0)
+        self.module_spec = RLModuleSpec(
+            observation_dim=probe.observation_dim,
+            action_dim=probe.action_dim,
+            hidden=tuple(config.module_hidden))
+        self.learner = DQNLearner(self.module_spec, config.learner,
+                                  seed=config.seed)
+        runner_cls = ray_tpu.remote(num_cpus=1)(DQNEnvRunner)
+        self.runners = [
+            runner_cls.remote(config.env, config.num_envs_per_env_runner,
+                              self.module_spec, seed=config.seed + 1000 * i)
+            for i in range(config.num_env_runners)]
+        self.buffer = ReplayBufferGroup(
+            num_shards=config.replay_shards,
+            capacity=config.replay_capacity, alpha=config.replay_alpha)
+        self._return_window: list[float] = []
+        self._transitions = 0
+
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self.iteration / max(1, cfg.epsilon_decay_iters))
+        return cfg.epsilon_start + frac * (cfg.epsilon_end - cfg.epsilon_start)
+
+    def train(self) -> dict:
+        cfg = self.config
+        eps = self._epsilon()
+        weights = self.learner.get_weights()
+        ray_tpu.get([r.set_weights.remote(weights) for r in self.runners],
+                    timeout=120)
+        batches = ray_tpu.get(
+            [r.sample.remote(cfg.rollout_fragment_length, eps)
+             for r in self.runners], timeout=300)
+        add_refs = []
+        for b in batches:
+            self._return_window.extend(b.pop("episode_returns"))
+            self._transitions += len(b["obs"])
+            add_refs.append(self.buffer.add_batch(b))
+        ray_tpu.get(add_refs, timeout=120)
+        self._return_window = self._return_window[-100:]
+        stats: dict = {}
+        if self._transitions >= cfg.learning_starts:
+            for _ in range(cfg.num_learner_updates):
+                batch, index_map, w = self.buffer.sample(
+                    cfg.train_batch_size, cfg.replay_beta)
+                if not batch:
+                    break
+                stats, td = self.learner.update(batch, w)
+                # TD errors feed back as new priorities (the prioritized
+                # part of prioritized replay).
+                self.buffer.update_priorities(index_map, td)
+        self.iteration += 1
+        return {
+            "training_iteration": self.iteration,
+            "num_env_steps_sampled": sum(len(b["obs"]) for b in batches),
+            "num_transitions": self._transitions,
+            "epsilon": eps,
+            "episode_return_mean": (float(np.mean(self._return_window))
+                                    if self._return_window else float("nan")),
+            **{f"learner/{k}": v for k, v in stats.items()},
+        }
+
+    def stop(self):
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        self.buffer.stop()

@@ -22,6 +22,7 @@ from ray_tpu.tune.search import (
     sample_from,
     uniform,
 )
+from ray_tpu.tune.search import Searcher, TPESearcher
 from ray_tpu.tune.trial import Trial
 from ray_tpu.tune.tuner import Result, ResultGrid, TuneConfig, Tuner
 
@@ -33,6 +34,8 @@ __all__ = [
     "PopulationBasedTraining",
     "Result",
     "ResultGrid",
+    "Searcher",
+    "TPESearcher",
     "Trial",
     "TuneConfig",
     "Tuner",

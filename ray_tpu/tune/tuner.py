@@ -40,6 +40,9 @@ class TuneConfig:
     mode: Optional[str] = None  # "min" | "max"
     num_samples: int = 1
     scheduler: Optional[Any] = None
+    #: a search.Searcher (e.g. TPESearcher): trials are then SUGGESTED
+    #: sequentially from completed results instead of pre-sampled
+    search_alg: Optional[Any] = None
     max_concurrent_trials: Optional[int] = None
     seed: Optional[int] = None
     resources_per_trial: Optional[dict] = None
@@ -116,14 +119,29 @@ class TuneController:
 
     def __init__(self, trainable: Callable, configs: list[dict],
                  tune_config: TuneConfig, run_config: RunConfig,
-                 exp_dir: str):
+                 exp_dir: str, param_space: Optional[dict] = None,
+                 trials: Optional[list] = None):
         self.trainable = trainable
         self.tc = tune_config
         self.rc = run_config
         self.exp_dir = exp_dir
-        self.trials = [Trial(cfg, "") for cfg in configs]
+        self.param_space = param_space or {}
+        self.searcher = tune_config.search_alg
+        if trials is not None:
+            self.trials = trials  # Tuner.restore passes rebuilt trials
+        else:
+            self.trials = [Trial(cfg, "") for cfg in configs]
         for t in self.trials:
             t.trial_dir = os.path.join(exp_dir, f"trial_{t.trial_id}")
+        if self.searcher is not None:
+            self.searcher.set_search_properties(
+                tune_config.metric, tune_config.mode, self.param_space)
+            # Feed restored finished trials back into the model (no-op for
+            # fresh runs; Tuner.restore currently rebuilds without a
+            # searcher, but a caller wiring one explicitly gets the data).
+            for t in self.trials:
+                if t.status == TERMINATED and t.last_result:
+                    self.searcher.observe(t.config, t.last_result)
         self.scheduler = tune_config.scheduler or sched_mod.FIFOScheduler()
         self.scheduler.setup(tune_config.metric, tune_config.mode)
         self._futures: dict = {}  # next_result future -> (trial, runner)
@@ -164,6 +182,12 @@ class TuneController:
         trial.error = error
         self._kill(trial)
         self.scheduler.on_trial_complete(self, trial)
+        if self.searcher is not None:
+            self.searcher.on_trial_complete(trial.trial_id, trial.last_result)
+        try:
+            self.save_experiment_state()
+        except Exception:
+            logger.exception("tune: experiment-state save failed")
 
     def exploit(self, trial: Trial, donor: Trial, new_config: dict):
         """PBT: restart `trial` from donor's checkpoint with a perturbed
@@ -174,14 +198,75 @@ class TuneController:
         trial.restore_from = donor.checkpoint_path
         self._start(trial)
 
+    # ----------------------------------------------------- experiment state
+    def save_experiment_state(self):
+        """Durable trial table (reference experiment_state-*.json written by
+        the TuneController): enough to Tuner.restore() an interrupted
+        experiment — finished trials keep results, unfinished ones re-run
+        from their last checkpoint."""
+        import cloudpickle
+
+        state = {
+            "num_samples": self.tc.num_samples,
+            "metric": self.tc.metric,
+            "mode": self.tc.mode,
+            "param_space": cloudpickle.dumps(self.param_space).hex(),
+            "trainable": cloudpickle.dumps(self.trainable).hex(),
+            "trials": [{
+                "trial_id": t.trial_id,
+                "config": cloudpickle.dumps(t.config).hex(),
+                "status": t.status,
+                "last_result": t.last_result,
+                "checkpoint_path": t.checkpoint_path,
+                "error": t.error,
+                "iteration": t.iteration,
+            } for t in self.trials],
+        }
+        import json
+
+        tmp = os.path.join(self.exp_dir, ".experiment_state.tmp")
+        with open(tmp, "w") as f:
+            json.dump(state, f)
+        os.replace(tmp, os.path.join(self.exp_dir, "experiment_state.json"))
+
+    def _maybe_suggest(self) -> Optional[Trial]:
+        """Searcher-driven trial creation (sequential; reference
+        SearchGenerator)."""
+        if self.searcher is None or len(self.trials) >= self.tc.num_samples:
+            return None
+        t = Trial({}, "")
+        cfg = self.searcher.suggest(t.trial_id)
+        if cfg is None:
+            return None
+        t.config = cfg
+        t.trial_dir = os.path.join(self.exp_dir, f"trial_{t.trial_id}")
+        self.trials.append(t)
+        return t
+
     # ---------------------------------------------------------- event loop
     def run(self) -> list[Trial]:
-        pending = deque(t for t in self.trials)
-        limit = self.tc.max_concurrent_trials or len(self.trials)
+        # Restored TERMINATED/errored-out trials are not re-queued.
+        pending = deque(t for t in self.trials if t.status == PENDING)
+        if self.tc.max_concurrent_trials:
+            limit = self.tc.max_concurrent_trials
+        elif self.searcher is not None:
+            # Searcher-driven runs MUST stay bounded or every sample is
+            # suggested before any result lands and the model never sees
+            # an observation (TPE degenerates to pure random). Default to
+            # the searcher's startup width.
+            limit = max(1, getattr(self.searcher, "n_startup", 4) or 4)
+        else:
+            limit = max(1, len(self.trials))
         while True:
             running = [t for t in self.trials if t.status == RUNNING]
             while pending and len(running) < limit:
                 t = pending.popleft()
+                self._start(t)
+                running.append(t)
+            while self.searcher is not None and len(running) < limit:
+                t = self._maybe_suggest()
+                if t is None:
+                    break
                 self._start(t)
                 running.append(t)
             if not running and not pending:
@@ -292,23 +377,76 @@ class Tuner:
 
     def __init__(self, trainable, *, param_space: Optional[dict] = None,
                  tune_config: Optional[TuneConfig] = None,
-                 run_config: Optional[RunConfig] = None):
+                 run_config: Optional[RunConfig] = None,
+                 _restored_trials: Optional[list] = None,
+                 _exp_dir: Optional[str] = None):
         if isinstance(trainable, JaxTrainer):
             trainable = _trainable_from_trainer(trainable)
         self._trainable = trainable
         self._param_space = param_space or {}
         self._tune_config = tune_config or TuneConfig()
         self._run_config = run_config or RunConfig()
+        self._restored_trials = _restored_trials
+        self._exp_dir = _exp_dir
+
+    @classmethod
+    def restore(cls, path: str, trainable=None) -> "Tuner":
+        """Resume an interrupted experiment from its directory (reference
+        tuner.py Tuner.restore): finished trials keep their results;
+        unfinished/errored trials re-run from their last checkpoint."""
+        import json
+
+        import cloudpickle
+
+        with open(os.path.join(path, "experiment_state.json")) as f:
+            state = json.load(f)
+        if trainable is None:
+            trainable = cloudpickle.loads(
+                bytes.fromhex(state["trainable"]))
+        elif isinstance(trainable, JaxTrainer):
+            trainable = _trainable_from_trainer(trainable)
+        param_space = cloudpickle.loads(bytes.fromhex(state["param_space"]))
+        trials = []
+        for ts in state["trials"]:
+            t = Trial(cloudpickle.loads(bytes.fromhex(ts["config"])), "")
+            t.trial_id = ts["trial_id"]
+            t.last_result = ts["last_result"]
+            t.checkpoint_path = ts["checkpoint_path"]
+            t.iteration = ts.get("iteration", 0)
+            if ts["status"] == TERMINATED:
+                t.status = TERMINATED  # keep the result; don't re-run
+            else:
+                # RUNNING (interrupted) / PENDING / ERROR: re-run, resuming
+                # from the last checkpoint when one exists.
+                t.status = PENDING
+                t.restore_from = ts["checkpoint_path"]
+            trials.append(t)
+        tc = TuneConfig(metric=state.get("metric"), mode=state.get("mode"),
+                        num_samples=state.get("num_samples", len(trials)))
+        return cls(trainable, param_space=param_space, tune_config=tc,
+                   run_config=RunConfig(), _restored_trials=trials,
+                   _exp_dir=path)
 
     def fit(self) -> ResultGrid:
         tc = self._tune_config
-        name = self._run_config.name or f"tune_{int(time.time())}"
-        exp_dir = os.path.join(self._run_config.resolved_storage(), name)
+        if self._exp_dir is not None:
+            exp_dir = self._exp_dir
+        else:
+            name = self._run_config.name or f"tune_{int(time.time())}"
+            exp_dir = os.path.join(self._run_config.resolved_storage(), name)
         os.makedirs(exp_dir, exist_ok=True)
-        configs = BasicVariantGenerator(tc.seed).generate(
-            self._param_space, tc.num_samples)
+        if self._restored_trials is not None:
+            configs = []
+        elif tc.search_alg is not None:
+            configs = []  # suggested live by the searcher
+        else:
+            configs = BasicVariantGenerator(tc.seed).generate(
+                self._param_space, tc.num_samples)
         controller = TuneController(self._trainable, configs, tc,
-                                    self._run_config, exp_dir)
+                                    self._run_config, exp_dir,
+                                    param_space=self._param_space,
+                                    trials=self._restored_trials)
+        controller.save_experiment_state()
         trials = controller.run()
         results = [
             Result(metrics=t.last_result, config=t.config,

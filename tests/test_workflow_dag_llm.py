@@ -161,3 +161,97 @@ def test_kv_cache_decode_matches_naive():
         nxt = jnp.argmax(logits[:, -1, :], axis=-1)
         toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
     assert np.array_equal(out, np.asarray(toks)), (out, np.asarray(toks))
+
+
+def test_compiled_dag_fan_in_fan_out(ray_start_4cpu):
+    """2-branch join DAG with a shared (fanned-out) upstream and multiple
+    outputs (reference compiled_dag_node MultiOutputNode + fan-in)."""
+    from ray_tpu.dag import InputNode, MultiOutputNode, compile
+
+    @ray_tpu.remote
+    def double(x):
+        return x * 2
+
+    @ray_tpu.remote
+    def inc(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def join(a, b):
+        return a + b  # fan-in: two upstream channels
+
+    with InputNode() as inp:
+        d = double.bind(inp)       # consumed by BOTH join and out2: fan-out
+        i = inc.bind(inp)
+        dag = MultiOutputNode([join.bind(d, i), inc.bind(d)])
+    cdag = compile(dag)
+    try:
+        for x in (1, 5, 10):
+            j, k = cdag.execute(x)
+            assert j == 2 * x + (x + 1), (x, j)
+            assert k == 2 * x + 1, (x, k)
+    finally:
+        cdag.teardown()
+
+
+def test_compiled_dag_actor_methods(ray_start_4cpu):
+    """Bound EXISTING-actor methods as DAG stages: the actor keeps its
+    state across executes and still serves normal calls (reference
+    actor.method.bind + experimental_compile)."""
+    from ray_tpu.dag import InputNode, compile
+
+    @ray_tpu.remote
+    class Stateful:
+        def __init__(self):
+            self.calls = 0
+
+        def scale(self, x):
+            self.calls += 1
+            return x * 10
+
+        def count(self):
+            return self.calls
+
+    @ray_tpu.remote
+    def plus1(x):
+        return x + 1
+
+    actor = Stateful.remote()
+    with InputNode() as inp:
+        dag = plus1.bind(actor.scale.bind(inp))
+    cdag = compile(dag)
+    try:
+        assert cdag.execute(1) == 11
+        assert cdag.execute(2) == 21
+        assert cdag.execute(3) == 31
+        # The actor's own state advanced AND it still answers normal calls
+        # concurrently with the compiled loop.
+        assert ray_tpu.get(actor.count.remote(), timeout=30) == 3
+    finally:
+        cdag.teardown()
+    # actor survives teardown (it's user-owned, not a stage actor)
+    assert ray_tpu.get(actor.count.remote(), timeout=30) == 3
+
+
+def test_compiled_dag_stage_error_propagates(ray_start_2cpu):
+    from ray_tpu.dag import InputNode, compile
+
+    @ray_tpu.remote
+    def boom(x):
+        raise ValueError("kaput")
+
+    @ray_tpu.remote
+    def after(x):
+        return x
+
+    with InputNode() as inp:
+        dag = after.bind(boom.bind(inp))
+    cdag = compile(dag)
+    try:
+        with pytest.raises(RuntimeError, match="kaput"):
+            cdag.execute(1)
+        # pipeline stays usable for the next execute
+        with pytest.raises(RuntimeError, match="kaput"):
+            cdag.execute(2)
+    finally:
+        cdag.teardown()
