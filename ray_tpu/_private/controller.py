@@ -1967,6 +1967,9 @@ class Controller:
                     "available": n.available.to_dict(),
                     "labels": n.labels,
                     "active_jobs": jobs_per_node.get(nid, 0),
+                    # Heartbeat freshness: consumers that must not trust a
+                    # dead-but-undetected node (elastic sizing) filter on it.
+                    "beat_age": time.monotonic() - n.last_beat,
                 }
                 for nid, n in self.nodes.items()
             },

@@ -74,7 +74,6 @@ class MultiAgentEnvRunner:
         self._explore = {mid: jax.jit(m.forward_exploration)
                         for mid, m in self.modules.items()}
         self.obs = self.env.obs()
-        n_agents = len(self.env.agent_ids)
         self._ep_ret = {aid: np.zeros(num_envs) for aid in self.env.agent_ids}
         self._done_returns: dict[str, list] = {aid: [] for aid in self.env.agent_ids}
 

@@ -50,16 +50,20 @@ class PrioritizedReplayBuffer:
         self._size = int(min(self._size + n, self.capacity))
         return self._size
 
-    def sample(self, batch_size: int, beta: float = 0.4):
+    def sample(self, batch_size: int, beta: float = 0.4,
+               normalize: bool = True):
         """-> (batch dict, indices, is_weights). Empty dict if not enough
-        data yet."""
+        data yet. normalize=False returns RAW (N*P)^-beta weights so a
+        sharded group can normalize by the GLOBAL max instead (per-shard
+        maxima would systematically over-weight low-priority shards)."""
         if self._size == 0:
             return {}, np.zeros(0, np.int64), np.zeros(0, np.float32)
         pri = self._priorities[:self._size] ** self.alpha
         probs = pri / pri.sum()
         idx = np.random.choice(self._size, size=batch_size, p=probs)
-        weights = (self._size * probs[idx]) ** (-beta)
-        weights = (weights / weights.max()).astype(np.float32)
+        weights = ((self._size * probs[idx]) ** (-beta)).astype(np.float32)
+        if normalize:
+            weights = weights / weights.max()
         batch = {k: v[idx] for k, v in self._storage.items()}
         return batch, idx.astype(np.int64), weights
 
@@ -95,7 +99,8 @@ class ReplayBufferGroup:
         """-> (merged batch, [(shard_i, indices)], weights)."""
         per = max(1, batch_size // len(self.shards))
         reps = ray_tpu.get(
-            [s.sample.remote(per, beta) for s in self.shards], timeout=120)
+            [s.sample.remote(per, beta, False) for s in self.shards],
+            timeout=120)
         batches, index_map, weights = [], [], []
         for i, (b, idx, w) in enumerate(reps):
             if len(idx) == 0:
@@ -107,7 +112,8 @@ class ReplayBufferGroup:
             return {}, [], np.zeros(0, np.float32)
         merged = {k: np.concatenate([b[k] for b in batches])
                   for k in batches[0]}
-        return merged, index_map, np.concatenate(weights)
+        w = np.concatenate(weights)
+        return merged, index_map, (w / w.max()).astype(np.float32)
 
     def update_priorities(self, index_map, td_errors: np.ndarray):
         off = 0
@@ -120,9 +126,9 @@ class ReplayBufferGroup:
         ray_tpu.get(refs, timeout=60)
 
     def size(self) -> int:
-        return sum(ray_tpu.get(
-            [s.stats.remote() for s in self.shards], timeout=60)[i]["size"]
-            for i in range(len(self.shards)))
+        reps = ray_tpu.get([s.stats.remote() for s in self.shards],
+                           timeout=60)
+        return sum(r["size"] for r in reps)
 
     def stop(self):
         for s in self.shards:

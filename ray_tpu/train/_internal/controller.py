@@ -69,14 +69,22 @@ class TrainController:
             return want
         try:
             from ray_tpu._private.rtconfig import CONFIG
+            from ray_tpu._private.worker import global_worker
 
-            # Let failure detection settle: right after a node dies its
-            # resources still look available until the heartbeat timeout,
-            # and sizing against them would hang the restart on actors
-            # that can never place.
-            time.sleep(CONFIG.heartbeat_interval_s
-                       * CONFIG.num_heartbeats_timeout + 0.5)
-            avail = ray_tpu.available_resources()
+            # Size against nodes with FRESH heartbeats only: right after a
+            # node dies its resources still look available until the
+            # timeout marks it dead, and sizing against them would hang
+            # the restart on actors that can never place. Filtering by
+            # beat age replaces the previous full-timeout sleep ON THE
+            # CONTROLLER THREAD (which stalled every restart for seconds).
+            snap = global_worker().state_snapshot()
+            fresh = CONFIG.heartbeat_interval_s * 3
+            avail: dict[str, float] = {}
+            for n in snap["nodes"].values():
+                if not n["alive"] or n.get("beat_age", 0.0) > fresh:
+                    continue
+                for k, v in n["available"].items():
+                    avail[k] = avail.get(k, 0.0) + v
         except Exception:
             return want
         per = self.scaling.worker_resources()

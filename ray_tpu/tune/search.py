@@ -207,8 +207,12 @@ class TPESearcher(Searcher):
                     bw * math.sqrt(2 * math.pi))
             return dens / (len(points) + 1)
 
-        bw_good = max(span / max(1.0, math.sqrt(len(good))), span * 0.02)
-        bw_bad = max(span / max(1.0, math.sqrt(len(bad) or 1)), span * 0.02)
+        # Bandwidth shrinks with the number of good points; the +1 keeps a
+        # SINGLE good anchor from getting a whole-range window (candidates
+        # then clamp-pile at the domain edges and the model degenerates to
+        # edge-probing — observed on log domains).
+        bw_good = max(span / (1.0 + len(good)), span * 0.02)
+        bw_bad = max(span / (1.0 + math.sqrt(len(bad) or 1)), span * 0.02)
         best_x, best_score = None, -1.0
         for _ in range(self.n_candidates):
             anchor = self.rng.choice(good)

@@ -210,6 +210,10 @@ class TuneController:
             "num_samples": self.tc.num_samples,
             "metric": self.tc.metric,
             "mode": self.tc.mode,
+            # Full configs ride pickled so restore keeps the searcher,
+            # scheduler, concurrency cap, and failure policy.
+            "tune_config": cloudpickle.dumps(self.tc).hex(),
+            "run_config": cloudpickle.dumps(self.rc).hex(),
             "param_space": cloudpickle.dumps(self.param_space).hex(),
             "trainable": cloudpickle.dumps(self.trainable).hex(),
             "trials": [{
@@ -224,9 +228,17 @@ class TuneController:
         }
         import json
 
+        def _default(o):
+            # User metrics are full of numpy scalars on this stack; a
+            # TypeError here would silently freeze the durable state.
+            try:
+                return float(o)
+            except (TypeError, ValueError):
+                return repr(o)
+
         tmp = os.path.join(self.exp_dir, ".experiment_state.tmp")
         with open(tmp, "w") as f:
-            json.dump(state, f)
+            json.dump(state, f, default=_default)
         os.replace(tmp, os.path.join(self.exp_dir, "experiment_state.json"))
 
     def _maybe_suggest(self) -> Optional[Trial]:
@@ -421,10 +433,16 @@ class Tuner:
                 t.status = PENDING
                 t.restore_from = ts["checkpoint_path"]
             trials.append(t)
-        tc = TuneConfig(metric=state.get("metric"), mode=state.get("mode"),
-                        num_samples=state.get("num_samples", len(trials)))
+        if state.get("tune_config"):
+            tc = cloudpickle.loads(bytes.fromhex(state["tune_config"]))
+        else:
+            tc = TuneConfig(metric=state.get("metric"),
+                            mode=state.get("mode"),
+                            num_samples=state.get("num_samples", len(trials)))
+        rc = (cloudpickle.loads(bytes.fromhex(state["run_config"]))
+              if state.get("run_config") else RunConfig())
         return cls(trainable, param_space=param_space, tune_config=tc,
-                   run_config=RunConfig(), _restored_trials=trials,
+                   run_config=rc, _restored_trials=trials,
                    _exp_dir=path)
 
     def fit(self) -> ResultGrid:

@@ -49,12 +49,33 @@ def _step_dir(workflow_id: str) -> str:
     return os.path.join(_STORAGE, workflow_id, "steps")
 
 
+def _hash_code(h, code):
+    """Deterministic code digest: bytecode + consts, recursing into nested
+    code objects (their repr embeds per-process memory addresses, which
+    would make keys nondeterministic across runs)."""
+    import types
+
+    h.update(code.co_code)
+    for c in code.co_consts:
+        if isinstance(c, types.CodeType):
+            _hash_code(h, c)
+        else:
+            h.update(repr(c).encode())
+
+
 def _step_key(node: DAGNode, child_keys: list[str]) -> str:
-    """Deterministic content key: function name + literal args + child step
+    """Deterministic content key: function CODE + literal args + child step
     keys. Same DAG -> same keys across runs, which is what memoization
-    keys on."""
+    keys on; hashing the bytecode (not just the name) means EDITING a
+    step's body invalidates its memoized results instead of silently
+    replaying stale ones (reference content-addresses via checkpointed
+    DAG state)."""
     h = hashlib.sha1()
     h.update(node.name.encode())
+    inner = getattr(node.fn, "_fn", node.fn)
+    code = getattr(inner, "__code__", None)
+    if code is not None:
+        _hash_code(h, code)
     for a in list(node.args) + sorted(node.kwargs.items()):
         if isinstance(a, DAGNode):
             continue  # covered by child_keys

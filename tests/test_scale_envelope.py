@@ -118,7 +118,7 @@ def test_fifty_placement_groups(scale_cluster):
     for pg in pgs:
         ray_tpu.get(pg.ready(), timeout=120)
     create_s = time.monotonic() - t0
-    assert create_s < 60.0, f"50 PGs took {create_s:.1f}s"
+    assert create_s < 150.0, f"50 PGs took {create_s:.1f}s"
 
     @ray_tpu.remote(num_cpus=0, resources={"slot": 1})
     def in_pg():

@@ -284,7 +284,9 @@ def test_tpe_searcher_converges(ray_start_4cpu):
     scores = [r.metrics["score"] for r in grid]
     import statistics
 
-    assert statistics.mean(scores[12:]) > statistics.mean(scores[:6]), scores
+    # Medians: TPE keeps a uniform exploration component, so one late
+    # outlier must not fail the direction-of-improvement check.
+    assert statistics.median(scores[12:]) > statistics.median(scores[:6]), scores
 
 
 def test_tuner_restore_resumes_experiment(ray_start_2cpu, tmp_path):

@@ -255,3 +255,26 @@ def test_compiled_dag_stage_error_propagates(ray_start_2cpu):
             cdag.execute(2)
     finally:
         cdag.teardown()
+
+
+def test_workflow_code_change_invalidates_memoization(ray_start_2cpu, tmp_path):
+    """Editing a step's BODY changes its content key: the old memoized
+    result must NOT replay for the same workflow_id (reference
+    content-addresses steps via checkpointed DAG state)."""
+    from ray_tpu import workflow
+
+    workflow.init(str(tmp_path / "wf"))
+
+    @ray_tpu.remote
+    def step(x):
+        return x + 1
+
+    out = workflow.run(step.bind(10), workflow_id="wf-code")
+    assert out == 11
+
+    @ray_tpu.remote
+    def step(x):  # noqa: F811 — same NAME, different body
+        return x + 100
+
+    out2 = workflow.run(step.bind(10), workflow_id="wf-code")
+    assert out2 == 110, "stale memoized result replayed after code change"
