@@ -112,6 +112,9 @@ class WorkerProc:
         self._gen_closed: set[str] = set()  # consumer abandoned the stream
         self._gen_cond = threading.Condition()
         self._prefetch_pool = None  # lazy: arg pre-localization threads
+        self._event_seq = 0  # event sampling counter (high-rate shedding)
+        self._event_win_start = 0.0
+        self._event_win_count = 0
         self._advertise_pusher: _BatchPusher | None = None
         self._running = True
 
@@ -470,10 +473,29 @@ class WorkerProc:
             reply = {"results": [], "error": None, "exec_failure": str(e)}
         self._reply_value(pusher, task_id, reply)
 
+    _EVENT_RATE_FULL = 500  # events/s below which everything records
+    _EVENT_SAMPLE = 64      # 1/N sampling above the rate threshold
+
     def _record_event(self, spec: TaskSpec, start: float, end: float,
                       ok: bool):
         """Buffer one execution event (batched to the controller; feeds
-        ray_tpu.timeline() and the state list APIs)."""
+        ray_tpu.timeline() and the state list APIs). ADAPTIVE shedding:
+        everything records at ordinary rates (full timelines), but past
+        _EVENT_RATE_FULL successful events/s this worker samples 1/N —
+        at tens of thousands of calls/s the per-event dict + push costs a
+        measurable third of the core budget (observed n:n actor bench
+        14.5k -> 22.5k/s; the reference task_event_buffer likewise sheds
+        load under pressure). Failures always record."""
+        if ok:
+            now = end
+            if now - self._event_win_start >= 1.0:
+                self._event_win_start = now
+                self._event_win_count = 0
+            self._event_win_count += 1
+            if self._event_win_count > self._EVENT_RATE_FULL:
+                self._event_seq += 1
+                if self._event_seq % self._EVENT_SAMPLE:
+                    return
         try:
             self._event_pusher.add({
                 "task_id": spec.task_id, "name": spec.name,

@@ -7,7 +7,8 @@ to Pallas TPU kernels (ops/flash_attention.py) without touching model code.
 
 from ray_tpu.ops.attention import dot_product_attention
 
-__all__ = ["dot_product_attention", "ring_attention", "ulysses_attention"]
+__all__ = ["decode_attention", "dot_product_attention", "ring_attention",
+           "ulysses_attention"]
 
 
 def __getattr__(name):
@@ -21,6 +22,8 @@ def __getattr__(name):
         from ray_tpu.ops.ring_attention import ring_attention as fn
     elif name == "ulysses_attention":
         from ray_tpu.ops.ulysses import ulysses_attention as fn
+    elif name == "decode_attention":
+        from ray_tpu.ops.decode_attention import decode_attention as fn
     else:
         raise AttributeError(name)
     globals()[name] = fn

@@ -159,9 +159,13 @@ def _deploy_app(app: Application, controller, route_prefix: Optional[str],
 
 def run(target: Application, *, route_prefix: str = "/",
         host: str = "127.0.0.1", port: int = 8000,
+        grpc_port: Optional[int] = None,
         _blocking: bool = True, timeout_s: float = 60.0) -> DeploymentHandle:
     """Deploy an application and start the HTTP ingress (reference
-    serve/api.py:run)."""
+    serve/api.py:run). grpc_port (0 = auto-pick) additionally starts the
+    gRPC ingress (reference gRPCProxy, proxy.py:530): unary calls at
+    /ray_tpu.serve.<deployment>/<method>, server streaming with the
+    'Stream' method suffix."""
     if not isinstance(target, Application):
         raise TypeError("serve.run expects Deployment.bind(...)")
     controller = _get_or_create_controller()
@@ -172,8 +176,12 @@ def run(target: Application, *, route_prefix: str = "/",
     proxy_cls = ray_tpu.remote(num_cpus=0, max_concurrency=64)(Proxy)
     proxy = proxy_cls.options(name=PROXY_NAME, lifetime="detached",
                               get_if_exists=True).remote(
-        CONTROLLER_NAME, host, port)
+        CONTROLLER_NAME, host, port, grpc_port)
     ray_tpu.get(proxy.ready.remote(), timeout=30)
+    if grpc_port is not None:
+        # The proxy may predate this run (get_if_exists reuses it with the
+        # FIRST run's constructor args): start the ingress in-place.
+        ray_tpu.get(proxy.ensure_grpc.remote(grpc_port), timeout=30)
     if _blocking:
         deadline = time.monotonic() + timeout_s
         st: dict = {}
@@ -185,6 +193,12 @@ def run(target: Application, *, route_prefix: str = "/",
         else:
             raise TimeoutError(f"deployments not ready after {timeout_s}s: {st}")
     return DeploymentHandle(ingress, CONTROLLER_NAME)
+
+
+def get_grpc_port() -> Optional[int]:
+    """Bound gRPC ingress port of the running proxy (None if disabled)."""
+    proxy = ray_tpu.get_actor(PROXY_NAME)
+    return ray_tpu.get(proxy.grpc_ready.remote(), timeout=10)
 
 
 def status() -> dict:

@@ -23,9 +23,11 @@ logger = logging.getLogger(__name__)
 
 class Proxy:
     def __init__(self, controller_name: str, host: str = "127.0.0.1",
-                 port: int = 8000):
+                 port: int = 8000, grpc_port: Optional[int] = None):
         self.controller_name = controller_name
         self.host, self.port = host, port
+        self.grpc_port = grpc_port  # None = gRPC ingress off
+        self._grpc_ingress = None
         self.routes: dict[str, str] = {}
         self._version = -1
         self._site = None
@@ -60,8 +62,28 @@ class Proxy:
             self.routes = rep["routes"]
         except Exception as e:
             logger.warning("serve proxy initial route fetch failed: %r", e)
+        if self.grpc_port is not None and self._grpc_ingress is None:
+            from ray_tpu.serve._private.grpc_proxy import GrpcIngress
+
+            self._grpc_ingress = GrpcIngress(self, self.host, self.grpc_port)
+            self.grpc_port = self._grpc_ingress.port
         asyncio.ensure_future(self._route_poll_loop())
         return self.port
+
+    async def grpc_ready(self) -> Optional[int]:
+        """Bound gRPC ingress port (None when disabled)."""
+        return self.grpc_port
+
+    async def ensure_grpc(self, grpc_port: Optional[int]) -> Optional[int]:
+        """Start the gRPC ingress on an ALREADY-RUNNING proxy (serve.run
+        reuses the detached proxy actor, so constructor args from the
+        first run would otherwise silently win over a later grpc_port)."""
+        if grpc_port is not None and self._grpc_ingress is None:
+            from ray_tpu.serve._private.grpc_proxy import GrpcIngress
+
+            self._grpc_ingress = GrpcIngress(self, self.host, grpc_port)
+            self.grpc_port = self._grpc_ingress.port
+        return self.grpc_port
 
     async def _route_poll_loop(self):
         while True:

@@ -71,17 +71,21 @@ class TrainController:
             from ray_tpu._private.rtconfig import CONFIG
             from ray_tpu._private.worker import global_worker
 
-            # Size against nodes with FRESH heartbeats only: right after a
-            # node dies its resources still look available until the
-            # timeout marks it dead, and sizing against them would hang
-            # the restart on actors that can never place. Filtering by
-            # beat age replaces the previous full-timeout sleep ON THE
-            # CONTROLLER THREAD (which stalled every restart for seconds).
+            # Size against nodes that have beaten SINCE we started looking:
+            # a node that died moments ago still shows alive (and its last
+            # beat still looks recent) until the detection timeout, and
+            # sizing against it would hang the restart on actors that can
+            # never place. Waiting two beat intervals and requiring
+            # beat_age < elapsed admits exactly the nodes with fresh
+            # evidence of life — a ~1s pause instead of the previous
+            # full-detection-window sleep (10s) on this thread.
+            t0 = time.monotonic()
+            time.sleep(CONFIG.heartbeat_interval_s * 2 + 0.2)
+            elapsed = time.monotonic() - t0
             snap = global_worker().state_snapshot()
-            fresh = CONFIG.heartbeat_interval_s * 3
             avail: dict[str, float] = {}
             for n in snap["nodes"].values():
-                if not n["alive"] or n.get("beat_age", 0.0) > fresh:
+                if not n["alive"] or n.get("beat_age", 0.0) > elapsed:
                     continue
                 for k, v in n["available"].items():
                     avail[k] = avail.get(k, 0.0) + v

@@ -49,18 +49,31 @@ def _step_dir(workflow_id: str) -> str:
     return os.path.join(_STORAGE, workflow_id, "steps")
 
 
-def _hash_code(h, code):
-    """Deterministic code digest: bytecode + consts, recursing into nested
-    code objects (their repr embeds per-process memory addresses, which
-    would make keys nondeterministic across runs)."""
+def _hash_const(h, c):
+    """Deterministic const digest. repr() is NOT enough: nested code
+    objects embed memory addresses and frozenset element order follows
+    per-process string-hash randomization — both would silently change a
+    step's key on every fresh interpreter and defeat resume."""
     import types
 
+    if isinstance(c, types.CodeType):
+        _hash_code(h, c)
+    elif isinstance(c, (frozenset, set)):
+        h.update(b"set")
+        for item in sorted(repr(i) for i in c):
+            h.update(item.encode())
+    elif isinstance(c, tuple):
+        h.update(b"tup")
+        for item in c:
+            _hash_const(h, item)
+    else:
+        h.update(repr(c).encode())
+
+
+def _hash_code(h, code):
     h.update(code.co_code)
     for c in code.co_consts:
-        if isinstance(c, types.CodeType):
-            _hash_code(h, c)
-        else:
-            h.update(repr(c).encode())
+        _hash_const(h, c)
 
 
 def _step_key(node: DAGNode, child_keys: list[str]) -> str:

@@ -279,3 +279,59 @@ def test_multiplexed_models(serve_shutdown):
         out = handle.options(multiplexed_model_id=mid).remote(None).result(
             timeout_s=60)
         assert out["model"] == f"model::{mid}"
+
+
+def test_grpc_ingress_unary_and_stream(ray_start_4cpu):
+    """gRPC ingress (reference gRPCProxy): unary calls and server-streaming
+    responses through the generic /ray_tpu.serve.<dep>/<method> surface."""
+    import pickle
+
+    import grpc
+
+    @serve.deployment(name="echo")
+    class Echo:
+        def __call__(self, request):
+            return {"got": request.body.decode(), "via": request.method}
+
+        def shout(self, request):
+            return request.body.decode().upper()
+
+        def counted(self, request):
+            n = int(request.body or b"3")
+            for i in range(n):
+                yield {"i": i}
+
+    serve.run(Echo.bind(), route_prefix="/echo", port=_free_port(),
+              grpc_port=0)
+    try:
+        gport = serve.get_grpc_port()
+        assert gport
+        chan = grpc.insecure_channel(f"127.0.0.1:{gport}")
+        ident = lambda b: b  # raw-bytes (de)serializers
+
+        call = chan.unary_unary("/ray_tpu.serve.echo/__call__",
+                                request_serializer=ident,
+                                response_deserializer=ident)
+        out = pickle.loads(call(b"hello", timeout=60))
+        assert out == {"got": "hello", "via": "GRPC"}
+
+        shout = chan.unary_unary("/ray_tpu.serve.echo/shout",
+                                 request_serializer=ident,
+                                 response_deserializer=ident)
+        assert pickle.loads(shout(b"quiet", timeout=60)) == "QUIET"
+
+        stream = chan.unary_stream("/ray_tpu.serve.echo/countedStream",
+                                   request_serializer=ident,
+                                   response_deserializer=ident)
+        items = [pickle.loads(b) for b in stream(b"4", timeout=120)]
+        assert items == [{"i": 0}, {"i": 1}, {"i": 2}, {"i": 3}]
+
+        # unknown deployment -> UNIMPLEMENTED
+        bad = chan.unary_unary("/ray_tpu.serve.nope/__call__",
+                               request_serializer=ident,
+                               response_deserializer=ident)
+        with pytest.raises(grpc.RpcError):
+            bad(b"", timeout=30)
+        chan.close()
+    finally:
+        serve.shutdown()
