@@ -1033,7 +1033,10 @@ class Controller:
         if not self.leases:
             return
         now = time.monotonic()
-        if now - self._last_need_push < 0.1:
+        # 20ms floor: a parked lease request's unblock chain is need-push ->
+        # owner idle-return -> regrant, so this throttle sits directly on
+        # multi-client handoff latency.
+        if now - self._last_need_push < 0.02:
             return
         self._last_need_push = now
         owners = {ent["owner"] for ent in self.leases.values()}

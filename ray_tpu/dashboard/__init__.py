@@ -21,16 +21,62 @@ from ray_tpu._private import rpc
 
 logger = logging.getLogger(__name__)
 
-_INDEX_HTML = """<html><head><title>ray_tpu dashboard</title></head><body>
-<h2>ray_tpu dashboard</h2><ul>
-<li><a href="/api/cluster_status">/api/cluster_status</a></li>
-<li><a href="/api/nodes">/api/nodes</a></li>
-<li><a href="/api/actors">/api/actors</a></li>
-<li><a href="/api/tasks">/api/tasks</a></li>
-<li><a href="/api/objects">/api/objects</a></li>
-<li><a href="/api/jobs">/api/jobs</a></li>
-<li><a href="/api/timeline">/api/timeline</a> (chrome trace; load in Perfetto)</li>
-</ul></body></html>"""
+# Single-file live UI (the miniature of the reference's React dashboard
+# client): vanilla JS polling the JSON APIs below, no build step, no deps.
+_INDEX_HTML = """<!doctype html><html><head><title>ray_tpu dashboard</title>
+<style>
+ body{font-family:ui-monospace,Menlo,monospace;margin:1.2rem;background:#101418;color:#d8dee6}
+ h1{font-size:1.1rem} h2{font-size:.95rem;margin:1.2rem 0 .4rem;color:#8ab4f8}
+ table{border-collapse:collapse;width:100%;font-size:.8rem}
+ th,td{text-align:left;padding:.25rem .6rem;border-bottom:1px solid #2a3138}
+ th{color:#9aa6b2;font-weight:600} .ok{color:#7ee787} .bad{color:#ff7b72}
+ #meta{color:#9aa6b2;font-size:.8rem} a{color:#8ab4f8}
+ .pill{display:inline-block;padding:0 .45rem;border-radius:.6rem;background:#1d2630;margin-right:.6rem}
+</style></head><body>
+<h1>ray_tpu dashboard</h1>
+<div id="meta"></div>
+<div id="res"></div>
+<h2>Nodes</h2><table id="nodes"></table>
+<h2>Actors</h2><table id="actors"></table>
+<h2>Jobs</h2><table id="jobs"></table>
+<h2>Recent tasks</h2><table id="tasks"></table>
+<p><a href="/api/timeline">timeline</a> (chrome trace; load in Perfetto) &middot;
+<a href="/metrics">prometheus /metrics</a></p>
+<script>
+const esc=(v)=>String(v).replace(/&/g,"&amp;").replace(/</g,"&lt;")
+  .replace(/>/g,"&gt;").replace(/"/g,"&quot;");
+const fmt=(o)=>esc(typeof o==="object"?JSON.stringify(o):o);
+function table(el,rows,cols){
+  let h="<tr>"+cols.map(c=>"<th>"+c+"</th>").join("")+"</tr>";
+  for(const r of rows) h+="<tr>"+cols.map(c=>{
+    let v=fmt(r[c]??"");
+    if(c==="alive"||c==="status"||c==="state"){
+      const good=(v===true||v==="true"||v==="ALIVE"||v==="RUNNING"||v==="SUCCEEDED");
+      v="<span class='"+(good?"ok":"bad")+"'>"+v+"</span>";}
+    return "<td>"+v+"</td>";}).join("")+"</tr>";
+  document.getElementById(el).innerHTML=h;
+}
+async function j(u){const r=await fetch(u);return r.json()}
+async function tick(){
+  try{
+    const [st,nodes,actors,jobs,tasks]=await Promise.all([
+      j("/api/cluster_status"),j("/api/nodes"),j("/api/actors"),
+      j("/api/jobs"),j("/api/tasks?limit=25")]);
+    document.getElementById("meta").textContent=
+      "updated "+new Date().toLocaleTimeString();
+    const tot=st.total||{},av=st.available||{};
+    document.getElementById("res").innerHTML=Object.keys(tot).map(k=>
+      "<span class='pill'>"+k+" "+(av[k]??0)+"/"+tot[k]+"</span>").join("");
+    table("nodes",nodes.nodes||[],["node_id","alive","address","total","available"]);
+    table("actors",actors.actors||[],["actor_id","class","state","name","node_id","restarts_used"]);
+    table("jobs",jobs.jobs||[],["submission_id","status","entrypoint","message"]);
+    const trows=(tasks.tasks||[]).slice(-25).reverse().map(t=>({...t,
+      duration_ms:(t.end&&t.start)?Math.round((t.end-t.start)*1000):""}));
+    table("tasks",trows,["name","kind","state","duration_ms","node_id"]);
+  }catch(e){document.getElementById("meta").textContent="refresh failed: "+e}
+}
+tick();setInterval(tick,2000);
+</script></body></html>"""
 
 
 class Dashboard:

@@ -71,6 +71,10 @@ class GrpcIngress:
             options=[("grpc.so_reuseport", 0)])
         self._server.add_generic_rpc_handlers((_Handler(self),))
         self.port = self._server.add_insecure_port(f"{host}:{port}")
+        if self.port == 0:
+            # grpc returns 0 on bind failure instead of raising; a silently
+            # dead ingress would report "enabled" while refusing everything.
+            raise OSError(f"gRPC ingress could not bind {host}:{port}")
         self._server.start()
 
     def stop(self):

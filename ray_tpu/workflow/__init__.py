@@ -57,17 +57,22 @@ def _hash_const(h, c):
     import types
 
     if isinstance(c, types.CodeType):
+        h.update(b"\x01code")
         _hash_code(h, c)
     elif isinstance(c, (frozenset, set)):
-        h.update(b"set")
+        # Length prefix + per-element terminators: without them distinct
+        # consts concatenate to identical digest streams ({1,2} vs {12}).
+        h.update(b"\x01set%d" % len(c))
         for item in sorted(repr(i) for i in c):
             h.update(item.encode())
+            h.update(b"\x00")
     elif isinstance(c, tuple):
-        h.update(b"tup")
+        h.update(b"\x01tup%d" % len(c))
         for item in c:
             _hash_const(h, item)
     else:
         h.update(repr(c).encode())
+        h.update(b"\x00")
 
 
 def _hash_code(h, code):

@@ -123,3 +123,45 @@ def test_dashboard_endpoints(ray_start_2cpu):
         assert any(ev.get("name") == "touch" for ev in trace)
     finally:
         d.stop()
+
+
+def test_remote_driver_client(ray_start_cluster):
+    """util.client: the remote-driver mode (reference ray://) — the full
+    API from a process holding only a controller address."""
+    from ray_tpu.util.client import connect
+
+    cluster = ray_start_cluster
+    cluster.add_node(num_cpus=2)
+    ctx = connect(f"ray://{cluster.address}")
+    try:
+        @ray_tpu.remote
+        def f(x):
+            return x + 1
+
+        assert ray_tpu.get(f.remote(41), timeout=60) == 42
+        assert "connected" in repr(ctx)
+    finally:
+        ctx.disconnect()
+    assert not ray_tpu.is_initialized()
+
+
+def test_dashboard_index_ui(ray_start_2cpu):
+    """The dashboard serves the live HTML view (reference React client's
+    role) alongside the JSON APIs."""
+    import urllib.request
+
+    from ray_tpu.dashboard import Dashboard
+
+    w = ray_tpu._private.worker.global_worker()
+    dash = Dashboard(f"{w.controller_addr[0]}:{w.controller_addr[1]}",
+                     port=0)
+    port = dash.start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/", timeout=10) as r:
+            html = r.read().decode()
+        assert "ray_tpu dashboard" in html
+        assert "/api/cluster_status" in html  # the UI polls the APIs
+        assert "<script>" in html
+    finally:
+        dash.stop()
