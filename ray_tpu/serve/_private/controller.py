@@ -149,7 +149,11 @@ class ServeController:
             out[name] = {
                 "target": st.target,
                 "ready": ready,
-                "status": "RUNNING" if ready >= max(1, st.target) else "UPDATING",
+                # target==0 is a VALID steady state for scaled-to-zero
+                # deployments (min_replicas=0), not an in-progress update.
+                "status": ("RUNNING" if ready >= st.target
+                           and (st.target > 0 or self._scale_to_zero_ok(st))
+                           else "UPDATING"),
             }
         return out
 
@@ -276,22 +280,57 @@ class ServeController:
         except Exception:
             pass
 
+    @staticmethod
+    def _scale_to_zero_ok(st: "_DeploymentState") -> bool:
+        cfg = st.spec.get("autoscaling_config") or {}
+        return int(cfg.get("min_replicas", 1)) == 0
+
+    async def notify_demand(self, name: str):
+        """A router has requests waiting with ZERO replicas up: scale from
+        zero immediately (reference: handle/router demand metrics feeding
+        autoscaling so min_replicas=0 deployments wake on traffic)."""
+        st = self.deployments.get(name)
+        if st is None:
+            return False
+        # Only autoscaled scale-to-zero deployments wake on demand: an
+        # operator who explicitly set num_replicas=0 paused the deployment
+        # and a waiting client must not override that.
+        if st.target < 1 and self._scale_to_zero_ok(st):
+            logger.info("serve: scale-from-zero %s (router demand)", name)
+            st.target = 1
+            st.low_ticks = 0
+        return True
+
     async def _autoscale(self, name: str, st: _DeploymentState):
         cfg = st.spec["autoscaling_config"]
         lo = int(cfg.get("min_replicas", 1))
         hi = int(cfg.get("max_replicas", max(lo, 1)))
         target_ongoing = float(cfg.get("target_ongoing_requests", 2))
+        target_latency = cfg.get("target_latency_ms")  # None = off
         reps = st.ready_replicas()
         if not reps:
             return
         total = 0
+        lat_sum, lat_n = 0.0, 0
         for _rid, h in reps:
             try:
                 s = await self._async_get(h.stats.remote(), timeout=2)
                 total += s["ongoing"]
+                if s.get("total"):
+                    lat_sum += s.get("ema_latency_ms", 0.0)
+                    lat_n += 1
             except Exception:
                 pass
         desired = max(lo, min(hi, math.ceil(total / target_ongoing) or lo))
+        if target_latency and lat_n:
+            # Target-latency policy (reference autoscaling_policy's
+            # latency-target variant): replicas scale with observed mean
+            # latency over the target; combined with the ongoing-requests
+            # policy by taking the tighter (larger) answer.
+            mean_lat = lat_sum / lat_n
+            by_latency = math.ceil(
+                len(reps) * mean_lat / float(target_latency))
+            desired = max(desired, min(hi, max(lo, by_latency)))
         if desired > st.target:
             logger.info("serve: autoscale %s %d -> %d (ongoing=%d)",
                         name, st.target, desired, total)

@@ -60,6 +60,9 @@ class Replica:
         self.ongoing = 0
         self.total = 0
         self._stream_pool = None  # lazy; see handle_request_streaming
+        # EMA of request latency (ms): the target-latency autoscaling
+        # signal (reference autoscaling_policy latency-based variants).
+        self.ema_latency_ms = 0.0
 
     async def ready(self) -> str:
         """Constructor finished (actor creation ran __init__); used as the
@@ -70,6 +73,7 @@ class Replica:
                              multiplexed_model_id: str = ""):
         self.ongoing += 1
         self.total += 1
+        _t0 = asyncio.get_event_loop().time()
         token = _multiplexed_model_id.set(multiplexed_model_id)
         try:
             # Calling the instance itself covers both function deployments
@@ -98,6 +102,9 @@ class Replica:
         finally:
             _multiplexed_model_id.reset(token)
             self.ongoing -= 1
+            dt_ms = (asyncio.get_event_loop().time() - _t0) * 1000.0
+            self.ema_latency_ms = (0.8 * self.ema_latency_ms + 0.2 * dt_ms
+                                   if self.total > 1 else dt_ms)
 
     async def handle_request_streaming(self, method_name: str, args: tuple,
                                        kwargs: dict,
@@ -109,6 +116,7 @@ class Replica:
         num_returns='streaming' by the router/proxy."""
         self.ongoing += 1
         self.total += 1
+        _t0 = asyncio.get_event_loop().time()
         token = _multiplexed_model_id.set(multiplexed_model_id)
         try:
             target = (self.callable if method_name == "__call__"
@@ -154,6 +162,11 @@ class Replica:
         finally:
             _multiplexed_model_id.reset(token)
             self.ongoing -= 1
+            # Whole-stream duration: for autoscaling it reflects replica
+            # occupancy, the quantity the latency target controls.
+            dt_ms = (asyncio.get_event_loop().time() - _t0) * 1000.0
+            self.ema_latency_ms = (0.8 * self.ema_latency_ms + 0.2 * dt_ms
+                                   if self.total > 1 else dt_ms)
 
     def stats(self) -> dict:
         """SYNC deliberately: async methods queue behind the
@@ -161,7 +174,7 @@ class Replica:
         true ongoing count exactly when the replica is saturated (sync
         methods run on the exec thread / thread pool, not the loop)."""
         return {"replica_id": self.replica_id, "ongoing": self.ongoing,
-                "total": self.total}
+                "total": self.total, "ema_latency_ms": self.ema_latency_ms}
 
     async def drain(self, timeout_s: float = 10.0) -> bool:
         """Wait for in-flight requests to finish (reference graceful

@@ -203,11 +203,31 @@ class Router:
         model to (reference multiplex cache locality), then fall back to
         pow-2-choices balancing."""
         deadline = time.monotonic() + timeout
+        last_demand_ping = 0.0
         while True:
+            if not self._have_replicas.is_set():
+                # Zero replicas with a request in hand: tell the controller
+                # so a min_replicas=0 deployment scales FROM zero on
+                # traffic (reference: router demand metrics feed
+                # autoscaling). Once per second per waiting request.
+                now = time.monotonic()
+                if now - last_demand_ping >= 1.0:
+                    last_demand_ping = now
+                    try:
+                        ctrl = ray_tpu.get_actor(self.controller_name)
+                        ctrl.notify_demand.remote(self.deployment)
+                    except Exception:
+                        pass
             left = deadline - time.monotonic()
-            if left <= 0 or not self._have_replicas.wait(timeout=left):
-                raise TimeoutError(
-                    f"no ready replicas for deployment {self.deployment!r}")
+            # A set event returns from wait() immediately, so the 1s cap
+            # only bounds the no-replica polls between demand pings.
+            if left <= 0 or not self._have_replicas.wait(
+                    timeout=min(left, 1.0)):
+                if time.monotonic() >= deadline:
+                    raise TimeoutError(
+                        f"no ready replicas for deployment "
+                        f"{self.deployment!r}")
+                continue
             with self._lock:
                 reps = self._replicas
                 if multiplexed_model_id and reps:

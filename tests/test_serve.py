@@ -335,3 +335,63 @@ def test_grpc_ingress_unary_and_stream(ray_start_4cpu):
         chan.close()
     finally:
         serve.shutdown()
+
+
+def test_autoscale_from_zero_and_back(ray_start_4cpu):
+    """min_replicas=0: the deployment idles at ZERO replicas, a request
+    wakes it (router demand -> controller scale-from-zero), and it drains
+    back to zero after the traffic stops."""
+
+    @serve.deployment(name="z", autoscaling_config={
+        "min_replicas": 0, "max_replicas": 2, "target_ongoing_requests": 2})
+    class Z:
+        def __call__(self, request=None):
+            return "up"
+
+    serve.run(Z.bind(), route_prefix="/z", port=_free_port())
+    try:
+        h = serve.get_deployment_handle("z")
+        # first request scales from zero (assign blocks until a replica is up)
+        assert h.remote().result(timeout_s=90) == "up"
+        # drains back to zero once idle (downscale patience x autoscale tick)
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            st = serve.status()["z"]
+            if st["ready"] == 0 and st["target"] == 0:
+                break
+            time.sleep(0.5)
+        st = serve.status()["z"]
+        assert st["target"] == 0 and st["ready"] == 0, st
+        # wakes again
+        assert h.remote().result(timeout_s=90) == "up"
+    finally:
+        serve.shutdown()
+
+
+def test_autoscale_target_latency(ray_start_4cpu):
+    """target_latency_ms scales up when observed latency exceeds the
+    target even though ongoing-requests alone would not."""
+
+    @serve.deployment(name="slowpoke", autoscaling_config={
+        "min_replicas": 1, "max_replicas": 3,
+        "target_ongoing_requests": 100,  # ongoing policy would never scale
+        "target_latency_ms": 30})
+    class Slow:
+        def __call__(self, request=None):
+            time.sleep(0.12)  # 120ms >> 30ms target
+            return "ok"
+
+    serve.run(Slow.bind(), route_prefix="/slow", port=_free_port())
+    try:
+        h = serve.get_deployment_handle("slowpoke")
+        # sustain some traffic so the latency EMA materializes
+        deadline = time.time() + 60
+        scaled = False
+        while time.time() < deadline and not scaled:
+            resps = [h.remote() for _ in range(4)]
+            for r in resps:
+                assert r.result(timeout_s=60) == "ok"
+            scaled = serve.status()["slowpoke"]["target"] >= 3
+        assert scaled, serve.status()
+    finally:
+        serve.shutdown()
