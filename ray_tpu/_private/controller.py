@@ -1992,5 +1992,26 @@ class Controller:
             "pgs": {pid: {"state": p["state"], "strategy": p["strategy"]} for pid, p in self.pgs.items()},
         }
 
+    async def _h_worker_stacks(self, conn, a):
+        """Route a live stack-dump request to the agent hosting the worker
+        (reference: dashboard -> reporter agent py-spy)."""
+        nid = a.get("node_id")
+        if nid is None:
+            # find the node by worker id from the lease/actor tables
+            for ent in self.actors.values():
+                if ent.worker_id == a["worker_id"]:
+                    nid = ent.node_id
+                    break
+            if nid is None:
+                for lease in self.leases.values():
+                    if lease["worker_id"] == a["worker_id"]:
+                        nid = lease["node_id"]
+                        break
+        nconn = self.node_conns.get(nid)
+        if nconn is None or nconn.closed:
+            return {"found": False, "stacks": "node not found"}
+        return await nconn.call("worker_stacks", worker_id=a["worker_id"],
+                                _timeout=10)
+
     async def _h_ping(self, conn, a):
         return {"pong": True, "session_id": self.session_id}

@@ -222,6 +222,8 @@ class NodeAgent:
             slot.assigned_at = time.monotonic()
             slot.held_resources = a.get("resources")
             return {"worker_id": slot.worker_id, "address": slot.address}
+        if method == "worker_stacks":
+            return await self._worker_stacks(a["worker_id"])
         if method == "run_job":
             return self._run_job(a)
         if method == "stop_job":
@@ -229,6 +231,44 @@ class NodeAgent:
         if method == "job_logs":
             return self._job_logs(a["submission_id"], int(a.get("offset", 0)))
         raise rpc.RpcError(f"agent: unknown ctrl method {method}")
+
+    async def _worker_stacks(self, worker_id: str) -> dict:
+        """Live thread stacks of one worker (the py-spy/reporter-agent
+        role, dashboard/modules/reporter/): SIGUSR1 triggers the worker's
+        faulthandler dump; the agent reads the per-pid file back."""
+        import signal
+
+        from ray_tpu._private.rtconfig import stack_dump_path
+
+        slot = self.workers.get(worker_id)
+        if slot is None or slot.proc.poll() is not None:
+            return {"found": False, "stacks": ""}
+        pid = slot.proc.pid
+        path = stack_dump_path(self.session_id, pid)
+        try:
+            offset = os.path.getsize(path)
+        except OSError:
+            offset = 0
+        try:
+            os.kill(pid, signal.SIGUSR1)
+        except OSError as e:
+            return {"found": False, "stacks": f"signal failed: {e}"}
+        # Dumps APPEND (C-level faulthandler on a pre-opened fd); wait for
+        # growth past our offset, then for one quiet tick so a mid-write
+        # read can't return a truncated dump.
+        last = offset
+        for _ in range(20):  # up to 1s
+            await asyncio.sleep(0.05)
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            if size > offset and size == last:
+                with open(path) as f:
+                    f.seek(offset)
+                    return {"found": True, "pid": pid, "stacks": f.read()}
+            last = size
+        return {"found": False, "stacks": "worker did not dump in time"}
 
     # ------------------------------------------------------------- jobs
     # Reference: the job supervisor runs the entrypoint as a shell

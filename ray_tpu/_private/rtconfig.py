@@ -121,3 +121,11 @@ class _Config:
 
 
 CONFIG = _Config()
+
+
+def stack_dump_path(session_id: str, pid: int) -> str:
+    """Where a worker's faulthandler stack dumps land (written by
+    worker_proc's SIGUSR1 registration, read back by the node agent for
+    /api/stacks). ONE definition so the two sides can't drift."""
+    return os.path.join(CONFIG.session_dir, session_id, "stacks",
+                        f"{pid}.txt")

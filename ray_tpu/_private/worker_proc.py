@@ -985,6 +985,30 @@ class WorkerProc:
         return {"results": results, "error": error_blob}
 
 
+def _install_stack_dump():
+    """SIGUSR1 -> dump all thread stacks to a per-pid file (the reporter
+    role the reference fills with py-spy via the dashboard agent,
+    dashboard/modules/reporter/). Read back by the node agent for the
+    dashboard's /api/stacks endpoint.
+
+    faulthandler.register installs a C-LEVEL handler on a pre-opened fd:
+    it dumps even when the worker is hung inside native code holding the
+    GIL — exactly the case an operator reaches for stacks. Dumps APPEND;
+    the agent reads from its recorded offset once the file stops growing."""
+    import faulthandler
+    import signal
+
+    from ray_tpu._private.rtconfig import stack_dump_path
+
+    path = stack_dump_path(os.environ.get("RT_SESSION", ""), os.getpid())
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        f = open(path, "a")
+        faulthandler.register(signal.SIGUSR1, file=f, all_threads=True)
+    except Exception:
+        pass
+
+
 def main():
     import signal
 
@@ -1002,6 +1026,7 @@ def main():
         os._exit(0)
 
     signal.signal(signal.SIGTERM, _term)
+    _install_stack_dump()
     logging.basicConfig(level=logging.INFO, format=f"[worker %(process)d] %(message)s")
     proc = WorkerProc()
     proc.start()

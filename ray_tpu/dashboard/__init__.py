@@ -122,6 +122,7 @@ class Dashboard:
             app.router.add_get("/api/objects", self._objects)
             app.router.add_get("/api/jobs", self._jobs)
             app.router.add_get("/api/timeline", self._timeline)
+            app.router.add_get("/api/stacks", self._stacks)
             app.router.add_get("/api/metrics", self._metrics_json)
             app.router.add_get("/metrics", self._metrics_prom)
             runner = web.AppRunner(app, access_log=None)
@@ -205,6 +206,20 @@ class Dashboard:
 
         rep = await self._a_call("list_jobs")
         return web.json_response({"jobs": rep["jobs"]})
+
+    async def _stacks(self, request):
+        """Live thread stacks of a worker:
+        /api/stacks?worker_id=...[&node_id=...] (reference: the reporter
+        agent's py-spy endpoints, dashboard/modules/reporter/)."""
+        from aiohttp import web
+
+        wid = request.query.get("worker_id")
+        if not wid:
+            return web.json_response(
+                {"error": "worker_id query param required"}, status=400)
+        rep = await self._a_call("worker_stacks", worker_id=wid,
+                                 node_id=request.query.get("node_id"))
+        return web.json_response(rep)
 
     async def _metrics_json(self, request):
         from aiohttp import web

@@ -85,3 +85,32 @@ def test_worker_logs_stream_to_driver(ray_start_2cpu, capfd):
         err = capfd.readouterr().err
         seen = "HELLO-FROM-WORKER-xyzzy" in err
     assert seen, "worker stdout never reached the driver"
+
+
+def test_live_worker_stack_dump(ray_start_2cpu):
+    """Live thread stacks of a running worker via SIGUSR1 + faulthandler
+    (the py-spy/reporter-agent role): the dump must show the worker's
+    executing frame."""
+    import time as _t
+
+    @ray_tpu.remote
+    class Busy:
+        def spin(self, seconds):
+            deadline = _t.time() + seconds
+            while _t.time() < deadline:
+                _t.sleep(0.01)
+            return "done"
+
+    a = Busy.remote()
+    ref = a.spin.remote(8.0)
+    _t.sleep(1.0)  # ensure the call is executing
+    w = ray_tpu._private.worker.global_worker()
+    # resolve the actor's worker id via the controller
+    info = w.io.run(w.controller.call(
+        "get_actor_info", actor_id=a._actor_id, wait=True))
+    rep = w.io.run(w.controller.call(
+        "worker_stacks", worker_id=info["worker_id"], node_id=None),
+        timeout=15)
+    assert rep["found"], rep
+    assert "spin" in rep["stacks"], rep["stacks"][:500]
+    assert ray_tpu.get(ref, timeout=60) == "done"
