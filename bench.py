@@ -157,6 +157,7 @@ def main():
     # The put path copies with the native THREADED memcpy; yardstick it
     # with the same machinery (a single-threaded np.copyto understates the
     # bound on multi-core hosts and swings with ambient load).
+    threaded = False
     try:
         from ray_tpu import _native
 
@@ -166,11 +167,13 @@ def main():
             t0 = time.perf_counter()
             _native.parallel_memcpy(mv, big)
             hw_memcpy = max(hw_memcpy, gb / (time.perf_counter() - t0))
+            threaded = True
     except Exception:
         pass
     mv = None  # a live view would pin the 100MB scratch past the del
     del scratch
-    log(f"  host memcpy ceiling: {hw_memcpy:.1f} GB/s (threaded)")
+    log(f"  host memcpy ceiling: {hw_memcpy:.1f} GB/s"
+        f"{' (threaded)' if threaded else ''}")
 
     def put_big():
         ref = ray_tpu.put(big)

@@ -2007,6 +2007,10 @@ class Controller:
                     if lease["worker_id"] == a["worker_id"]:
                         nid = lease["node_id"]
                         break
+            if nid is None:
+                return {"found": False,
+                        "stacks": f"worker {a['worker_id'][:12]} not found "
+                                  f"in the actor or lease tables"}
         nconn = self.node_conns.get(nid)
         if nconn is None or nconn.closed:
             return {"found": False, "stacks": "node not found"}

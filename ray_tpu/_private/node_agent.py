@@ -245,10 +245,14 @@ class NodeAgent:
             return {"found": False, "stacks": ""}
         pid = slot.proc.pid
         path = stack_dump_path(self.session_id, pid)
+        # Truncate between requests: dumps append (C-level faulthandler on
+        # an O_APPEND-style fd), and a polled endpoint would otherwise grow
+        # the file unboundedly over a long-lived worker's life.
         try:
-            offset = os.path.getsize(path)
+            os.truncate(path, 0)
         except OSError:
-            offset = 0
+            pass
+        offset = 0
         try:
             os.kill(pid, signal.SIGUSR1)
         except OSError as e:

@@ -1006,7 +1006,14 @@ def _install_stack_dump():
         f = open(path, "a")
         faulthandler.register(signal.SIGUSR1, file=f, all_threads=True)
     except Exception:
-        pass
+        # Registration failed (unwritable dir, ENOSPC): install a NO-OP
+        # handler anyway — SIGUSR1's default disposition TERMINATES the
+        # process, so a later /api/stacks probe must not kill a healthy
+        # worker just because its dump file couldn't be opened.
+        try:
+            signal.signal(signal.SIGUSR1, lambda s_, f_: None)
+        except Exception:
+            pass
 
 
 def main():
