@@ -71,6 +71,12 @@ def init(
         # silently disable RT_* env resolution for the rest of the process.
         _config_baseline = dict(CONFIG._overrides)
         CONFIG.apply_system_config(_system_config)
+        if CONFIG.fault_injection:
+            # Chaos-test gate: must flip on BEFORE the head/agent/worker
+            # connections are created so the injector tracks them.
+            from ray_tpu._private import rpc as _rpc
+
+            _rpc.enable_fault_injection()
         if address is None:
             # Submitted jobs inherit the cluster address from their runner
             # (reference: RAY_ADDRESS set by the job supervisor).
@@ -116,6 +122,13 @@ def shutdown():
     if _head is not None:
         _head.stop()
         _head = None
+    # Session-scoped fault injection dies with the session (env-gated
+    # injection is process-scoped and stays): stale rules must not apply
+    # to a later init() that never asked for injection.
+    if CONFIG.fault_injection and not os.environ.get("RT_FAULT_INJECTION"):
+        from ray_tpu._private import rpc as _rpc
+
+        _rpc.disable_fault_injection()
     # _system_config overrides are session-scoped: restore the pre-init
     # override table so the next init() in this process starts clean.
     if _config_baseline is not None:

@@ -156,6 +156,12 @@ class Controller:
         # reaper must recognize an actor owner even after its entry's
         # worker_id was cleared by the death bookkeeping.
         self._actor_host_workers: set[str] = set()
+        # node_id -> latest minted incarnation. Survives the NodeState
+        # (incremented across SUSPECT->DEAD->rejoin), so a zombie agent
+        # from ANY previous life is fenced, not just the last one.
+        self.node_incarnations: dict[str, int] = {}
+        # Observability for the fencing path (asserted by chaos tests).
+        self.stale_incarnation_rejections = 0
 
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
         if CONFIG.controller_persist_dir:
@@ -350,7 +356,13 @@ class Controller:
         kind = conn.meta.get("kind")
         if kind == "node":
             nid = conn.meta["node_id"]
-            asyncio.ensure_future(self._node_died(nid))
+            node = self.nodes.get(nid)
+            if node is None or conn.meta.get("incarnation") != node.incarnation:
+                # A previous incarnation's connection closing (the agent
+                # already re-registered on a fresh one): not a liveness
+                # event for the CURRENT life.
+                return
+            asyncio.ensure_future(self._node_suspect(nid, conn))
         elif kind == "client":
             wid = conn.meta.get("worker_id")
             self.client_conns.pop(wid, None)
@@ -362,7 +374,7 @@ class Controller:
                 self._reap_owned_actors(wid, conn.meta.get("mode")))
             asyncio.ensure_future(self._reap_borrows(wid))
 
-    def _reconcile_reported_worker(self, nid: str, node: "NodeState", w: dict):
+    async def _reconcile_reported_worker(self, nid: str, node: "NodeState", w: dict):
         """One inventory entry from a re-registering agent (controller
         restart FT). Actors whose workers survived re-bind in place —
         running calls on their direct pipes never noticed the outage."""
@@ -370,26 +382,57 @@ class Controller:
         held = w.get("resources")
         if aid:
             ent = self.actors.get(aid)
-            if ent is not None and ent.state in ("RECOVERING", "PENDING"):
-                try:
-                    self.pending.remove(ent.spec)  # un-queue a re-creation
-                except ValueError:
-                    pass
-                ent.state = "ALIVE"
+            rebindable = (
+                ent is not None
+                and (ent.state in ("RECOVERING", "PENDING")
+                     # RESTARTING re-binds only while the re-creation is
+                     # still QUEUED (cancellable); once it dispatched, a
+                     # second instance is already being built elsewhere.
+                     or (ent.state == "RESTARTING"
+                         and ent.spec in self.pending)))
+            if ent is not None and ent.state == "ALIVE" \
+                    and ent.worker_id == w["worker_id"]:
+                # Already bound to exactly this worker (raced reconcile
+                # paths): refresh the address and make sure the (possibly
+                # fresh) NodeState carries the charge.
                 ent.node_id = nid
-                ent.worker_id = w["worker_id"]
                 ent.address = tuple(w["address"])
-                self._actor_host_workers.add(w["worker_id"])
                 if held and not ent.resources_held:
                     node.available.subtract(ResourceSet(_raw=held))
                     ent.resources_held = True
-                for fut in ent.waiters:
-                    if not fut.done():
-                        fut.set_result(None)
-                ent.waiters.clear()
-                self._publish("actor", {"actor_id": aid, "state": "ALIVE"})
-                logger.info("actor %s re-bound to surviving worker %s",
-                            aid[:8], w["worker_id"][:8])
+                return
+            if ent is None:
+                # Unknown actor (e.g. restart without persistence): not
+                # provably stale — leave the worker alone like before.
+                return
+            if not rebindable:
+                # Split-brain zombie: the actor is DEAD, already
+                # restarted/rebound elsewhere, or its re-creation already
+                # dispatched — and now an old instance's worker resurfaces
+                # on a returning node, still serving its pipes. Exactly one
+                # instance may live: reap the resurfaced one.
+                await self._reap_stale_worker(nid, w["worker_id"], aid,
+                                              "resurfaced after its restart")
+                return
+            try:
+                self.pending.remove(ent.spec)  # un-queue a re-creation
+            except ValueError:
+                pass
+            ent.state = "ALIVE"
+            ent.node_id = nid
+            ent.worker_id = w["worker_id"]
+            ent.address = tuple(w["address"])
+            self._actor_host_workers.add(w["worker_id"])
+            if held and not ent.resources_held:
+                node.available.subtract(ResourceSet(_raw=held))
+                ent.resources_held = True
+            for fut in ent.waiters:
+                if not fut.done():
+                    fut.set_result(None)
+            ent.waiters.clear()
+            self._publish("actor", {"actor_id": aid, "state": "ALIVE"})
+            logger.info("actor %s re-bound to surviving worker %s",
+                        aid[:8], w["worker_id"][:8])
         elif w.get("state") == "busy" and held:
             # A controller-dispatched task still running; charge its
             # resources so the scheduler doesn't oversubscribe the node,
@@ -399,6 +442,22 @@ class Controller:
             node.available.subtract(ResourceSet(_raw=held))
             if w.get("task_id"):
                 self._reconciled_busy[w["task_id"]] = (nid, dict(held))
+
+    async def _reap_stale_worker(self, nid: str, wid: str, aid: str,
+                                 why: str):
+        """Kill a resurfaced actor instance whose entry no longer points at
+        it (exactly one instance may live). ONE implementation for both
+        reconcile paths so the zombie-reap protocol cannot drift."""
+        nconn = self.node_conns.get(nid)
+        if nconn is None or nconn.closed:
+            return
+        logger.warning(
+            "actor %s: stale instance on returning node %s (%s); killing "
+            "the zombie worker %s", aid[:8], nid[:8], why, wid[:8])
+        try:
+            await nconn.push("kill_worker", worker_id=wid)
+        except Exception:
+            pass
 
     async def _p_reassert_leases(self, conn, a):
         """An owner re-declares leases it held across a controller restart
@@ -456,40 +515,111 @@ class Controller:
 
     # ------------------------------------------------------- registration
     async def _h_register(self, conn, a):
+        incarnation = None
         if a["kind"] == "node":
             nid = a["node_id"]
-            node = NodeState(nid, tuple(a["address"]), ResourceSet(_raw=a["resources"]), a.get("labels"))
-            node.last_beat = time.monotonic()
-            self.nodes[nid] = node
-            self.node_conns[nid] = conn
-            conn.meta.update(kind="node", node_id=nid)
-            # Re-registration after a controller restart: the agent reports
-            # its live worker inventory so this (fresh) controller can
-            # rebuild accounting — bind recovering actors to their still-
-            # running workers; charge dedicated/busy slots' resources.
-            # Leased slots are charged by their OWNER's reassert_leases
-            # (the owner knows the lease ids; the agent doesn't).
-            for w in a.get("workers") or ():
-                self._reconcile_reported_worker(nid, node, w)
+            # Mint the next incarnation for this node_id. Every registration
+            # is a new life; messages and conn-close events carrying an
+            # older incarnation are fenced from then on.
+            incarnation = self.node_incarnations.get(nid, 0) + 1
+            self.node_incarnations[nid] = incarnation
+            conn.label = conn.label or "node"
+            existing = self.nodes.get(nid)
+            if existing is not None and existing.liveness in ("ALIVE", "SUSPECT"):
+                # The agent reconnected within the grace window (or raced
+                # its own connection loss): reconcile IN PLACE. The
+                # NodeState keeps its resource accounting; the inventory
+                # diff below releases whatever died during the blip.
+                node = existing
+                was = node.liveness
+                node.liveness = "ALIVE"
+                node.address = tuple(a["address"])
+                if a.get("labels") is not None:  # {} clears, like fresh path
+                    node.labels = a["labels"]
+                node.incarnation = incarnation
+                node.last_beat = time.monotonic()
+                # The agent may have restarted with a DIFFERENT resource
+                # config: apply the capacity delta while preserving the
+                # frozen in-use accounting (available can go negative on a
+                # shrink; fits() then refuses placements until work drains).
+                new_total = ResourceSet(_raw=a["resources"])
+                if new_total.raw() != node.total.raw():
+                    node.available.add(new_total)
+                    node.available.subtract(node.total)
+                    node.total = new_total
+                self.node_conns[nid] = conn
+                conn.meta.update(kind="node", node_id=nid,
+                                 incarnation=incarnation)
+                await self._reconcile_returned_node(
+                    nid, node, a.get("workers") or ())
+                logger.info("node %s re-registered (was %s) as incarnation "
+                            "%d; reconciled in place", nid[:8], was,
+                            incarnation)
+            else:
+                node = NodeState(nid, tuple(a["address"]),
+                                 ResourceSet(_raw=a["resources"]), a.get("labels"))
+                node.incarnation = incarnation
+                node.last_beat = time.monotonic()
+                self.nodes[nid] = node
+                self.node_conns[nid] = conn
+                conn.meta.update(kind="node", node_id=nid,
+                                 incarnation=incarnation)
+                # Re-registration after a controller restart (or a return
+                # after DEAD): the agent reports its live worker inventory
+                # so this controller can rebuild accounting — bind
+                # recovering actors to their still-running workers; charge
+                # dedicated/busy slots' resources. Leased slots are charged
+                # by their OWNER's reassert_leases (the owner knows the
+                # lease ids; the agent doesn't).
+                for w in a.get("workers") or ():
+                    await self._reconcile_reported_worker(nid, node, w)
+                logger.info("node %s registered with %s (incarnation %d)",
+                            nid[:8], node.total.to_dict(), incarnation)
             if self._parked_reasserts:
                 self._retry_parked_reasserts()
             self._retry_pending_pgs()
             self._kick()
             self._publish("node", {"node_id": nid, "alive": True,
+                                   "liveness": "ALIVE",
                                    "resources": node.total.to_dict()})
-            logger.info("node %s registered with %s", nid[:8], node.total.to_dict())
         else:
             wid = a["worker_id"]
             self.client_conns[wid] = conn
+            conn.label = conn.label or "client"
             conn.meta.update(kind="client", worker_id=wid,
                              mode=a.get("mode"),
                              address=tuple(a["address"]) if a.get("address") else None)
         return {"session_id": self.session_id, "config": CONFIG.snapshot(),
-                "log_sub": self._any_log_sub()}
+                "log_sub": self._any_log_sub(), "incarnation": incarnation}
+
+    def _fenced_node(self, conn, a) -> Optional[NodeState]:
+        """Resolve the node a message is about, REJECTING messages from a
+        previous incarnation (reference: raylet registration epochs; SWIM
+        incarnation numbers). The incarnation comes from the payload echo
+        when present, else from the connection's registration meta — so a
+        zombie agent that never re-registered is fenced by its old conn."""
+        nid = a.get("node_id") or (conn.meta.get("node_id")
+                                   if conn is not None else None)
+        if nid is None:
+            return None
+        node = self.nodes.get(nid)
+        if node is None:
+            return None
+        inc = a.get("incarnation")
+        if inc is None and conn is not None:
+            inc = conn.meta.get("incarnation")
+        if inc is not None and inc != node.incarnation:
+            self.stale_incarnation_rejections += 1
+            logger.warning(
+                "rejected stale-incarnation message for node %s "
+                "(incarnation %s, current %s)", nid[:8], inc,
+                node.incarnation)
+            return None
+        return node
 
     async def _p_heartbeat(self, conn, a):
-        node = self.nodes.get(a["node_id"])
-        if node is not None:
+        node = self._fenced_node(conn, a)
+        if node is not None and node.liveness != "DEAD":
             node.last_beat = time.monotonic()
             if "shm_used" in a:
                 node.shm_used = a["shm_used"]
@@ -692,7 +822,7 @@ class Controller:
             # dispatched it, so the normal release path can't fire).
             nid, raw = rec
             node = self.nodes.get(nid)
-            if node is not None and node.alive:
+            if node is not None and node.liveness != "DEAD":
                 node.available.add(ResourceSet(_raw=raw))
                 self._kick()
         info = self.dispatched.pop(task_id, None)
@@ -954,7 +1084,9 @@ class Controller:
                 b["available"].add(demand)
                 return
         node = self.nodes.get(nid)
-        if node is not None and node.alive:
+        # SUSPECT nodes still take releases: their accounting is frozen, not
+        # discarded, and must be correct if the agent reconnects in time.
+        if node is not None and node.liveness != "DEAD":
             node.available.add(demand)
 
     def _drop_lease(self, lease_id: str, release: bool = True):
@@ -1153,6 +1285,9 @@ class Controller:
         return {"submission_id": sid, "status": job["status"]}
 
     async def _p_job_done(self, conn, a):
+        if conn is not None and conn.meta.get("kind") == "node" \
+                and self._fenced_node(conn, a) is None:
+            return  # stale-incarnation zombie
         job = self.jobs.get(a["submission_id"])
         if job is None or job["status"] not in ("PENDING", "RUNNING"):
             return
@@ -1530,7 +1665,7 @@ class Controller:
         ent.resources_held = False
         if ent.node_id is not None:
             node = self.nodes.get(ent.node_id)
-            if node is not None and node.alive:
+            if node is not None and node.liveness != "DEAD":
                 self._release(ent.node_id, ent.spec, ResourceSet(_raw=ent.spec.resources))
             self._kick()
 
@@ -1677,6 +1812,9 @@ class Controller:
     async def _p_worker_died(self, conn, a):
         """Node agent reports a worker process exit. `cause="oom"` marks a
         memory-monitor kill so owners surface OutOfMemoryError."""
+        if conn is not None and conn.meta.get("kind") == "node" \
+                and self._fenced_node(conn, a) is None:
+            return  # stale-incarnation zombie: must not kill current state
         cause = a.get("cause")
         if a.get("worker_id"):
             await self._lease_worker_died(a["worker_id"], cause=cause)
@@ -1698,11 +1836,139 @@ class Controller:
                 self._kick()
 
     # ------------------------------------------------------- node failure
+    async def _node_suspect(self, nid: str, conn=None):
+        """The node's control connection closed. Instead of declaring it
+        dead (and restarting ALIVE actors whose workers are still serving
+        their direct pipes — split-brain duplicate actors on a TCP blip),
+        move it to SUSPECT for a grace window: leases and actors are
+        FROZEN — kept, charged, not restarted — and the node is
+        unschedulable. An agent re-registration within the window
+        reconciles in place (_h_register); only expiry promotes to DEAD."""
+        node = self.nodes.get(nid)
+        if node is None or node.liveness != "ALIVE":
+            return
+        if conn is not None and conn.meta.get("incarnation") != node.incarnation:
+            # The agent re-registered between the close callback's fence
+            # check and this task running: the close belongs to a previous
+            # life, and suspecting the NEW life would kill a healthy node
+            # at grace expiry (nothing would ever clear the suspicion).
+            return
+        grace = CONFIG.node_suspect_grace_s
+        if grace <= 0:  # configured off: the old kill-on-close behavior
+            await self._node_died(nid)
+            return
+        node.liveness = "SUSPECT"
+        node.suspect_since = time.monotonic()
+        incarnation = node.incarnation
+        if conn is None or self.node_conns.get(nid) is conn:
+            self.node_conns.pop(nid, None)
+        logger.warning("node %s connection lost; SUSPECT for %.1fs grace "
+                       "(incarnation %d)", nid[:8], grace, incarnation)
+        self._publish("node", {"node_id": nid, "alive": False,
+                               "liveness": "SUSPECT"})
+        await asyncio.sleep(grace)
+        current = self.nodes.get(nid)
+        if (current is node and node.liveness == "SUSPECT"
+                and node.incarnation == incarnation):
+            logger.warning("node %s suspicion grace expired; declaring dead",
+                           nid[:8])
+            await self._node_died(nid)
+
+    async def _reconcile_returned_node(self, nid: str, node: NodeState,
+                                       reported: list):
+        """A SUSPECT (or racing-ALIVE) node's agent re-registered within the
+        grace window. The NodeState — and with it all resource accounting —
+        survived the blip, so only the DIFF needs work: anything the agent
+        no longer reports died during the outage and takes the normal death
+        paths now; everything else stays bound exactly as it was (running
+        calls on direct worker pipes never noticed)."""
+        by_wid = {w["worker_id"]: w for w in reported}
+        # ALIVE actors hosted here: re-bind to their surviving workers (and
+        # cancel any queued re-creation a racing path produced); restart the
+        # ones whose workers died during the blip.
+        for aid, ent in list(self.actors.items()):
+            if ent.node_id != nid or ent.state != "ALIVE":
+                continue
+            w = by_wid.get(ent.worker_id)
+            if w is not None and (w.get("actor_id") in (None, aid)):
+                for spec in list(self.pending):
+                    if spec.actor_id == aid:
+                        self.pending.remove(spec)  # cancel queued re-creation
+                if w.get("address"):
+                    ent.address = tuple(w["address"])
+            else:
+                await self._actor_worker_died(
+                    aid, f"worker died during node {nid[:8]} suspicion blip",
+                    worker_id=ent.worker_id)
+        # Tasks this controller dispatched to the node: retry the ones whose
+        # workers are gone (their task_done can never come). A worker can be
+        # missing from inventory while still SPAWNING (no address yet), so
+        # reap it explicitly — its work is being retried elsewhere, and a
+        # dedicated worker finishing startup later would otherwise be
+        # orphaned on the node forever with its accounting already released.
+        nconn = self.node_conns.get(nid)
+        for task_id, info in list(self.dispatched.items()):
+            if info["node_id"] != nid or info["worker_id"] in by_wid:
+                continue
+            self.dispatched.pop(task_id, None)
+            if nconn is not None and not nconn.closed:
+                try:
+                    await nconn.push("kill_worker",
+                                     worker_id=info["worker_id"])
+                except Exception:
+                    pass
+            spec = info["spec"]
+            if spec.kind == ACTOR_CREATE:
+                # The idempotent instance-death path: releases the held
+                # resources before deciding restart-vs-bury.
+                await self._actor_worker_died(
+                    spec.actor_id,
+                    f"worker died during node {nid[:8]} suspicion blip",
+                    worker_id=info["worker_id"])
+                continue
+            self._release(nid, spec, ResourceSet(_raw=spec.resources))
+            await self._retry_or_fail(
+                spec, f"worker died during node {nid[:8]} suspicion blip")
+        # Leases whose workers died during the blip: invalidate so owners
+        # requeue their in-flight specs (surviving leases stay untouched —
+        # their direct pipes were never involved in the outage).
+        for lease_id, ent in list(self.leases.items()):
+            if ent["node_id"] == nid and ent["worker_id"] not in by_wid:
+                await self._lease_worker_died(ent["worker_id"])
+        # Inventory sweep for bindings that dissolved DURING the blip, when
+        # no kill/unlease push could reach the agent: an actor that was
+        # kill()ed or restarted away leaves a zombie instance still serving
+        # its pipes (exactly one instance may live — reap it); a lease that
+        # was returned/reaped leaves the slot stuck 'leased' forever.
+        lease_wids = {l["worker_id"] for l in self.leases.values()}
+        nconn = self.node_conns.get(nid)
+        for w in reported:
+            wid = w["worker_id"]
+            aid = w.get("actor_id")
+            if aid:
+                ent = self.actors.get(aid)
+                # PENDING/RECOVERING stay: an in-flight creation's worker is
+                # judged by the dispatched-tasks loop above, not reaped.
+                if ent is None or ent.state not in ("DEAD", "RESTARTING",
+                                                    "ALIVE"):
+                    continue
+                if ent.state == "ALIVE" and ent.worker_id == wid:
+                    continue  # correctly re-bound above
+                await self._reap_stale_worker(
+                    nid, wid, aid, f"entry is {ent.state} after the blip")
+            elif w.get("state") == "leased" and wid not in lease_wids:
+                if nconn is not None and not nconn.closed:
+                    try:
+                        await nconn.push("unlease_worker", worker_id=wid)
+                    except Exception:
+                        pass
+        self._kick()
+
     async def _node_died(self, nid: str):
         node = self.nodes.get(nid)
-        if node is None or not node.alive:
+        if node is None or node.liveness == "DEAD":
             return
-        node.alive = False
+        node.liveness = "DEAD"
         self.node_conns.pop(nid, None)
         self._reconciled_busy = {
             t: (n, r) for t, (n, r) in self._reconciled_busy.items()
@@ -1767,6 +2033,11 @@ class Controller:
             now = time.monotonic()
             for nid, node in list(self.nodes.items()):
                 if node.alive and node.last_beat and now - node.last_beat > timeout:
+                    await self._node_died(nid)
+                elif (node.liveness == "SUSPECT" and now - node.suspect_since
+                        > CONFIG.node_suspect_grace_s + interval):
+                    # Belt and braces: the per-suspicion expiry task owns
+                    # promotion to DEAD; this catches it getting lost.
                     await self._node_died(nid)
             try:
                 await self._sweep_dying()
@@ -1866,7 +2137,10 @@ class Controller:
             if pgid == pg_id:
                 b = self.pg_bundles.pop((pgid, idx))
                 node = self.nodes.get(b["node"])
-                if node is not None and node.alive:
+                # SUSPECT accounting is frozen, not discarded: skipping the
+                # release would leave the node permanently undercounted
+                # after it reconciles back to ALIVE.
+                if node is not None and node.liveness != "DEAD":
                     node.available.add(b["reserved"])
         self._kick()
         return {}
@@ -1898,6 +2172,16 @@ class Controller:
         return {"keys": [k for (n, k) in self.kv if n == ns and k.startswith(prefix)]}
 
     # ------------------------------------------------------------ state API
+    async def _h_kill_node(self, conn, a):
+        """Explicit node removal (cluster_utils.remove_node, scale-down
+        termination): skips the suspicion grace window — an operator kill
+        is a fact, not a connection blip — and runs the death path now."""
+        nid = a["node_id"]
+        if nid not in self.nodes:
+            return {"ok": False}
+        await self._node_died(nid)
+        return {"ok": True}
+
     async def _h_drain_node(self, conn, a):
         """Mark a node unschedulable (autoscaler scale-down handshake;
         reference DrainNode, gcs_node_manager). Running work is untouched;
@@ -1965,6 +2249,8 @@ class Controller:
             "nodes": {
                 nid: {
                     "alive": n.alive,
+                    "liveness": n.liveness,
+                    "incarnation": n.incarnation,
                     "address": n.address,
                     "total": n.total.to_dict(),
                     "available": n.available.to_dict(),

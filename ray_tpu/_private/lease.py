@@ -186,7 +186,8 @@ class LeaseManager:
         try:
             conn = await rpc.connect(
                 *lease.addr, on_push=self._on_worker_push,
-                on_close=self._on_worker_conn_close, timeout=10)
+                on_close=self._on_worker_conn_close, timeout=10,
+                label="lease")
             rep = await conn.call("whoami", _timeout=10)
             if rep.get("worker_id") != lease.worker_id:
                 await conn.close()

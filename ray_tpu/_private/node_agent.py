@@ -79,6 +79,13 @@ class NodeAgent:
         self._stopping = False
         self._reconnecting = False  # single-flight controller reconnect
         self.port = 0
+        # Controller-minted at registration, echoed on every push so the
+        # controller can fence messages from a previous life of this node.
+        self.incarnation = 0
+        # pid -> lock serializing stack-dump requests: two concurrent
+        # /api/stacks probes share one append-mode dump file per pid, and
+        # an unserialized second truncate would cut the first's read short.
+        self._stack_locks: dict[int, asyncio.Lock] = {}
 
     async def start(self) -> int:
         self._idle_waiters = deque()
@@ -94,6 +101,7 @@ class NodeAgent:
                     on_request=self._on_ctrl_request,
                     on_push=self._on_ctrl_push,
                     on_close=self._on_ctrl_conn_close,
+                    label="ctrl",
                 )
                 break
             except OSError:
@@ -108,6 +116,7 @@ class NodeAgent:
             resources=self.resources_raw,
             labels=self.labels,
         )
+        self.incarnation = rep.get("incarnation") or 0
         CONFIG.load_snapshot(rep["config"])
         self.logs_enabled = bool(rep.get("log_sub", False))
         self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
@@ -179,6 +188,7 @@ class NodeAgent:
                     on_push=self._on_ctrl_push,
                     on_close=self._on_ctrl_conn_close,
                     timeout=5,
+                    label="ctrl",
                 )
                 rep = await conn.call(
                     "register", kind="node", node_id=self.node_id,
@@ -186,6 +196,7 @@ class NodeAgent:
                     resources=self.resources_raw, labels=self.labels,
                     workers=self._worker_inventory(), _timeout=10)
                 self.controller = conn
+                self.incarnation = rep.get("incarnation") or 0
                 CONFIG.load_snapshot(rep["config"])
                 self.logs_enabled = bool(rep.get("log_sub", False))
                 logger.info("agent %s: re-registered with restarted "
@@ -245,34 +256,45 @@ class NodeAgent:
             return {"found": False, "stacks": ""}
         pid = slot.proc.pid
         path = stack_dump_path(self.session_id, pid)
-        # Truncate between requests: dumps append (C-level faulthandler on
-        # an O_APPEND-style fd), and a polled endpoint would otherwise grow
-        # the file unboundedly over a long-lived worker's life.
-        try:
-            os.truncate(path, 0)
-        except OSError:
-            pass
-        offset = 0
-        try:
-            os.kill(pid, signal.SIGUSR1)
-        except OSError as e:
-            return {"found": False, "stacks": f"signal failed: {e}"}
-        # Dumps APPEND (C-level faulthandler on a pre-opened fd); wait for
-        # growth past our offset, then for one quiet tick so a mid-write
-        # read can't return a truncated dump.
-        last = offset
-        for _ in range(20):  # up to 1s
-            await asyncio.sleep(0.05)
+        # Serialize per pid: concurrent probes share one append-mode dump
+        # file, and a second request's truncate would cut the first's
+        # read short mid-dump.
+        lock = self._stack_locks.setdefault(pid, asyncio.Lock())
+        async with lock:
+            if len(self._stack_locks) > 64:  # prune locks of gone workers
+                live = {s.proc.pid for s in self.workers.values()}
+                for p in [p for p in self._stack_locks
+                          if p not in live and p != pid]:
+                    self._stack_locks.pop(p, None)
+            # Truncate between requests: dumps append (C-level faulthandler
+            # on an O_APPEND-style fd), and a polled endpoint would
+            # otherwise grow the file unboundedly over a long-lived
+            # worker's life.
             try:
-                size = os.path.getsize(path)
+                os.truncate(path, 0)
             except OSError:
-                continue
-            if size > offset and size == last:
-                with open(path) as f:
-                    f.seek(offset)
-                    return {"found": True, "pid": pid, "stacks": f.read()}
-            last = size
-        return {"found": False, "stacks": "worker did not dump in time"}
+                pass
+            offset = 0
+            try:
+                os.kill(pid, signal.SIGUSR1)
+            except OSError as e:
+                return {"found": False, "stacks": f"signal failed: {e}"}
+            # Dumps APPEND (C-level faulthandler on a pre-opened fd); wait
+            # for growth past our offset, then for one quiet tick so a
+            # mid-write read can't return a truncated dump.
+            last = offset
+            for _ in range(20):  # up to 1s
+                await asyncio.sleep(0.05)
+                try:
+                    size = os.path.getsize(path)
+                except OSError:
+                    continue
+                if size > offset and size == last:
+                    with open(path) as f:
+                        f.seek(offset)
+                        return {"found": True, "pid": pid, "stacks": f.read()}
+                last = size
+            return {"found": False, "stacks": "worker did not dump in time"}
 
     # ------------------------------------------------------------- jobs
     # Reference: the job supervisor runs the entrypoint as a shell
@@ -318,7 +340,8 @@ class NodeAgent:
         try:
             await self.controller.push(
                 "job_done", submission_id=sid, returncode=proc.returncode,
-                stopped=stopped)
+                stopped=stopped, node_id=self.node_id,
+                incarnation=self.incarnation)
         except Exception:
             pass
 
@@ -397,6 +420,7 @@ class NodeAgent:
             try:
                 await self.controller.push(
                     "heartbeat", node_id=self.node_id,
+                    incarnation=self.incarnation,
                     shm_used=self.store.shm_dir_usage())
             except Exception:
                 continue
@@ -409,6 +433,7 @@ class NodeAgent:
                 raise rpc.RpcError("unknown worker")
             slot.conn = conn
             slot.address = tuple(a["address"])
+            conn.label = conn.label or "worker"
             conn.meta["worker_id"] = a["worker_id"]
             slot.registered.set()
             if slot.dedicated:
@@ -654,6 +679,8 @@ class NodeAgent:
                     actor_id=slot.actor_id,
                     reason=reason,
                     cause=cause,
+                    node_id=self.node_id,
+                    incarnation=self.incarnation,
                 )
             except Exception:
                 pass

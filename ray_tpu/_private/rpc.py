@@ -16,11 +16,179 @@ import asyncio
 import struct
 import threading
 import traceback
+import weakref
 from typing import Awaitable, Callable, Optional
 
 from ray_tpu._private.serialization import dumps_oob, loads_oob
 
 _HDR = struct.Struct("<Q")
+
+
+# ------------------------------------------------------- fault injection
+# Deterministic chaos layer for tests (reference: Ray's testing_asio
+# delay/failure injection, src/ray/common/test/testing_asio.h role).
+# Connections carry a `label` naming their class ("node" for the
+# controller<->agent link, "lease" for worker<->worker lease pipes, ...);
+# rules match (label, direction, method) and apply on deterministic frame
+# schedules. The transport pays ONE module-global None check per frame when
+# injection is off; nothing else changes.
+
+
+class FaultRule:
+    """One injection rule. Frames are counted per rule (under a lock, so
+    the schedule is deterministic): the first `after` matching frames pass
+    untouched, the next `times` (None = all) get `action` applied.
+
+    Actions: "drop" (frame vanishes), "delay" (frame waits `delay_s`),
+    "dup" (frame is delivered twice), "sever" (the connection is closed as
+    if the TCP link reset — both sides observe a normal close)."""
+
+    __slots__ = ("label", "action", "direction", "methods", "after", "times",
+                 "delay_s", "match", "hits", "applied")
+
+    def __init__(self, label, action, direction="both", methods=None,
+                 after=0, times=None, delay_s=0.0, match=None):
+        assert action in ("drop", "delay", "dup", "sever"), action
+        assert direction in ("send", "recv", "both"), direction
+        self.label = label
+        self.action = action
+        self.direction = direction
+        self.methods = set(methods) if methods else None
+        self.after = after
+        self.times = times
+        self.delay_s = delay_s
+        self.match = match  # optional fn(msg_dict) -> bool
+        self.hits = 0      # matching frames seen (before after/times gating)
+        self.applied = 0   # frames the action actually hit
+
+
+class FaultInjector:
+    """Registry of live connections + active fault rules (tests only).
+
+    Enable with `enable_fault_injection()` (or RT_FAULT_INJECTION=1 /
+    `_system_config={"fault_injection": True}`) BEFORE the connections
+    under test are created; disable with `disable_fault_injection()`.
+    `stats` counts applied actions so tests can assert the schedule fired.
+    """
+
+    def __init__(self):
+        self._conns: "weakref.WeakSet" = weakref.WeakSet()
+        self._rules: list[FaultRule] = []
+        self._lock = threading.Lock()
+        self.stats: dict[str, int] = {}
+
+    # -- connection registry ----------------------------------------------
+    def track(self, conn) -> None:
+        # Connections register from their event-loop threads while tests
+        # iterate from the main thread: both sides take the lock.
+        with self._lock:
+            self._conns.add(conn)
+
+    def connections(self, label: str | None = None) -> list:
+        with self._lock:
+            conns = list(self._conns)
+        return [c for c in conns
+                if not c.closed
+                and (label is None or getattr(c, "label", None) == label)]
+
+    def sever(self, label: str | None = None, match=None,
+              count: int | None = None) -> int:
+        """Close matching live connections (a simulated TCP reset): both
+        endpoints observe an ordinary connection close. `match` further
+        filters on the connection object (e.g. by conn.meta["node_id"]).
+        Returns how many connections were severed. Callable from any
+        thread — the close is marshalled onto each connection's loop."""
+        n = 0
+        for conn in self.connections(label):
+            if match is not None and not match(conn):
+                continue
+            self.sever_conn(conn)
+            n += 1
+            if count is not None and n >= count:
+                break
+        with self._lock:
+            self.stats["sever"] = self.stats.get("sever", 0) + n
+        return n
+
+    @staticmethod
+    def sever_conn(conn) -> None:
+        loop = getattr(conn, "loop", None)
+        if loop is not None and loop.is_running():
+            asyncio.run_coroutine_threadsafe(conn.close(), loop)
+        else:  # not started yet / loop gone: best-effort direct close
+            conn.closed = True
+
+    # -- rules -------------------------------------------------------------
+    def add_rule(self, label: str | None, action: str, *, direction="both",
+                 methods=None, after: int = 0, times: int | None = None,
+                 delay_s: float = 0.0, match=None) -> FaultRule:
+        rule = FaultRule(label, action, direction, methods, after, times,
+                         delay_s, match)
+        with self._lock:
+            self._rules.append(rule)
+        return rule
+
+    def remove_rule(self, rule: FaultRule) -> None:
+        with self._lock:
+            if rule in self._rules:
+                self._rules.remove(rule)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._rules.clear()
+            self.stats.clear()
+
+    def pick(self, conn, direction: str, msg: dict) -> Optional[FaultRule]:
+        """First rule whose filter matches AND whose after/times schedule
+        admits this frame. Counting happens under the lock, so a schedule
+        like after=2,times=1 hits exactly the third matching frame."""
+        if not self._rules:
+            return None
+        label = getattr(conn, "label", None)
+        with self._lock:
+            for r in self._rules:
+                if r.label is not None and r.label != label:
+                    continue
+                if r.direction != "both" and r.direction != direction:
+                    continue
+                if r.methods is not None and msg.get("m") not in r.methods:
+                    continue
+                if r.match is not None and not r.match(msg):
+                    continue
+                r.hits += 1
+                if r.hits <= r.after:
+                    continue
+                if r.times is not None and r.applied >= r.times:
+                    continue
+                r.applied += 1
+                self.stats[r.action] = self.stats.get(r.action, 0) + 1
+                return r
+        return None
+
+
+_INJECTOR: Optional[FaultInjector] = None
+
+
+def enable_fault_injection() -> FaultInjector:
+    global _INJECTOR
+    if _INJECTOR is None:
+        _INJECTOR = FaultInjector()
+    return _INJECTOR
+
+
+def disable_fault_injection() -> None:
+    global _INJECTOR
+    _INJECTOR = None
+
+
+def fault_injector() -> Optional[FaultInjector]:
+    return _INJECTOR
+
+
+import os as _os  # noqa: E402
+
+if _os.environ.get("RT_FAULT_INJECTION", "").lower() in ("1", "true", "yes"):
+    enable_fault_injection()
 
 
 class RpcError(Exception):
@@ -100,11 +268,14 @@ class Connection:
         self.on_close: Optional[Callable[["Connection"], None]] = None
         self.closed = False
         self.meta: dict = {}  # server-side: who is this peer (set by register)
+        self.label: Optional[str] = None  # fault-injection connection class
         self._read_task: Optional[asyncio.Task] = None
         self.loop: Optional[asyncio.AbstractEventLoop] = None
 
     def start(self):
         self.loop = asyncio.get_running_loop()
+        if _INJECTOR is not None:
+            _INJECTOR.track(self)
         self._read_task = asyncio.ensure_future(self._read_loop())
 
     @property
@@ -115,10 +286,32 @@ class Connection:
             return None
 
     async def _write(self, msg: dict):
+        repeat, delay = 1, 0.0
+        if _INJECTOR is not None:
+            rule = _INJECTOR.pick(self, "send", msg)
+            if rule is not None:
+                if rule.action == "drop":
+                    return
+                if rule.action == "delay":
+                    delay = rule.delay_s
+                elif rule.action == "dup":
+                    repeat = 2
+                elif rule.action == "sever":
+                    try:
+                        self.writer.close()
+                    except Exception:
+                        pass
+                    raise ConnectionClosed("fault injection: connection severed")
         parts = _encode(msg)
         async with self._wlock:
-            for p in parts:
-                self.writer.write(p)
+            if delay:
+                # Sleep INSIDE the write lock: a delayed frame must hold up
+                # younger frames like a slow link would — per-connection
+                # reordering is a fault TCP cannot produce.
+                await asyncio.sleep(delay)
+            for _ in range(repeat):
+                for p in parts:
+                    self.writer.write(p)
             await self.writer.drain()
 
     async def call(self, method: str, _timeout: float | None = None, **payload):
@@ -201,23 +394,38 @@ class Connection:
         except (ConnectionClosed, ConnectionResetError, BrokenPipeError):
             pass
 
+    def _dispatch_msg(self, msg: dict):
+        kind = msg["k"]
+        if kind == "req":
+            asyncio.ensure_future(self._handle_request(msg))
+        elif kind == "rep":
+            fut = self._pending.get(msg["id"])
+            if fut is not None and not fut.done():
+                if msg["ok"]:
+                    fut.set_result(msg["v"])
+                else:
+                    fut.set_exception(RemoteCallError(msg.get("m", "?"), msg["v"]))
+        elif kind == "push":
+            if self.on_push is not None:
+                asyncio.ensure_future(self.on_push(self, msg["m"], msg["a"]))
+
     async def _read_loop(self):
         try:
             while True:
                 msg = await _read_msg(self.reader)
-                kind = msg["k"]
-                if kind == "req":
-                    asyncio.ensure_future(self._handle_request(msg))
-                elif kind == "rep":
-                    fut = self._pending.get(msg["id"])
-                    if fut is not None and not fut.done():
-                        if msg["ok"]:
-                            fut.set_result(msg["v"])
-                        else:
-                            fut.set_exception(RemoteCallError(msg.get("m", "?"), msg["v"]))
-                elif kind == "push":
-                    if self.on_push is not None:
-                        asyncio.ensure_future(self.on_push(self, msg["m"], msg["a"]))
+                if _INJECTOR is not None:
+                    rule = _INJECTOR.pick(self, "recv", msg)
+                    if rule is not None:
+                        if rule.action == "drop":
+                            continue
+                        if rule.action == "delay":
+                            await asyncio.sleep(rule.delay_s)
+                        elif rule.action == "sever":
+                            raise ConnectionClosed(
+                                "fault injection: connection severed")
+                        elif rule.action == "dup":
+                            self._dispatch_msg(msg)
+                self._dispatch_msg(msg)
         except (ConnectionClosed, asyncio.CancelledError):
             pass
         except Exception:
@@ -410,6 +618,9 @@ class LocalConnection:
         self.on_close: Optional[Callable] = None
         self.closed = False
         self.meta: dict = {}
+        self.label: Optional[str] = None  # fault-injection connection class
+        if _INJECTOR is not None:
+            _INJECTOR.track(self)
 
     @property
     def peername(self):
@@ -420,6 +631,31 @@ class LocalConnection:
         peer = self.peer
         if peer is None or peer.closed:
             raise ConnectionClosed("local peer went away")
+        if _INJECTOR is not None:
+            # The in-process transport has no frames; model the message
+            # itself as one (send direction only — there is no reader side).
+            rule = _INJECTOR.pick(
+                self, "send", {"k": kind, "m": method, "a": payload})
+            if rule is not None:
+                if rule.action == "drop":
+                    if reply_to is not None:
+                        loop, fut = reply_to
+                        loop.call_soon_threadsafe(
+                            _fut_set_exc, fut,
+                            ConnectionClosed("fault injection: frame dropped"))
+                    return
+                if rule.action == "sever":
+                    self._close_both()
+                    raise ConnectionClosed(
+                        "fault injection: connection severed")
+                if rule.action == "delay":
+                    peer.loop.call_soon_threadsafe(
+                        peer.loop.call_later, rule.delay_s, peer._dispatch,
+                        kind, method, payload, reply_to)
+                    return
+                if rule.action == "dup":
+                    peer.loop.call_soon_threadsafe(
+                        peer._dispatch, kind, method, payload, None)
         peer.loop.call_soon_threadsafe(peer._dispatch, kind, method, payload, reply_to)
 
     async def call(self, method: str, _timeout: float | None = None, **payload):
@@ -465,6 +701,8 @@ class LocalConnection:
         except Exception:
             value = None
             err = RemoteCallError(method, traceback.format_exc())
+        if reply_to is None:
+            return  # fault-injected duplicate of a request: reply discarded
         loop, fut = reply_to
         if err is None:
             loop.call_soon_threadsafe(_fut_set_result, fut, value)
@@ -507,12 +745,14 @@ async def connect(
     on_push=None,
     on_close=None,
     timeout: float = 30.0,
+    label: str | None = None,
 ) -> Connection:
     server = _LOCAL_SERVERS.get(port) if host in ("127.0.0.1", "localhost") else None
     if server is not None and server.loop is not None:
         client = LocalConnection(asyncio.get_running_loop())
         serv_end = LocalConnection(server.loop)
         client.peer, serv_end.peer = serv_end, client
+        client.label = label
         client.on_request, client.on_push, client.on_close = on_request, on_push, on_close
         serv_end.on_request = server._on_request
         serv_end.on_push = server._on_push
@@ -533,6 +773,7 @@ async def connect(
     if reader is None:
         reader, writer = await asyncio.wait_for(asyncio.open_connection(host, port), timeout)
     conn = Connection(reader, writer)
+    conn.label = label
     conn.on_request = on_request
     conn.on_push = on_push
     conn.on_close = on_close

@@ -54,6 +54,18 @@ _flag("lineage_reconstruction_enabled", bool, True)
 # core_worker.proto:459): agents/workers/drivers retry the controller
 # address this long before giving up (workers exit; drivers error).
 _flag("controller_reconnect_timeout_s", float, 30.0)
+# Node-liveness suspicion window (reference GCS: a raylet connection drop
+# does NOT immediately declare the node dead — health checks tolerate a
+# reconnect). When the controller<->agent connection closes, the node goes
+# SUSPECT for this long: leases and ALIVE actors are frozen, not restarted.
+# An agent re-registering within the window reconciles in place; only
+# expiry (or an explicit kill) runs the death path. <= 0 restores the old
+# kill-on-close behavior.
+_flag("node_suspect_grace_s", float, 2.0)
+# Deterministic RPC fault injection (tests): enables rpc.FaultInjector so
+# chaos tests can sever/drop/delay/duplicate frames on named connection
+# classes. Zero-cost on the frame path when off.
+_flag("fault_injection", bool, False)
 # Borrower protocol: how long an owner-freed ESCAPED object survives at the
 # controller waiting for a borrower to register (covers the in-flight window
 # between the owner shipping a ref inside a payload and the receiving process

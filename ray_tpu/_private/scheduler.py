@@ -18,15 +18,21 @@ from ray_tpu._private.task_spec import SchedulingStrategy
 
 
 class NodeState:
-    __slots__ = ("node_id", "address", "total", "available", "alive", "last_beat",
-                 "labels", "draining", "shm_used")
+    __slots__ = ("node_id", "address", "total", "available", "liveness",
+                 "last_beat", "labels", "draining", "shm_used", "incarnation",
+                 "suspect_since")
 
     def __init__(self, node_id: str, address: tuple, total: ResourceSet, labels: dict | None = None):
         self.node_id = node_id
         self.address = address
         self.total = total
         self.available = total.copy()
-        self.alive = True
+        # Liveness state machine (reference GcsNodeManager + health checks,
+        # but with an explicit SUSPECT stage): ALIVE -> SUSPECT on
+        # connection loss, SUSPECT -> ALIVE on re-registration within the
+        # grace window, SUSPECT -> DEAD on expiry. DEAD is terminal for
+        # this NodeState (a returning agent gets a fresh one).
+        self.liveness = "ALIVE"  # ALIVE | SUSPECT | DEAD
         self.last_beat = 0.0
         self.labels = labels or {}
         # Draining (autoscaler scale-down handshake): schedulable = False.
@@ -34,6 +40,17 @@ class NodeState:
         self.draining = False
         # Heartbeat-reported shm-resident bytes (spilled blocks excluded).
         self.shm_used = 0
+        # Controller-minted, monotonically increasing per node_id: fences
+        # messages and connection-close events from a previous life of
+        # this node (a zombie agent can never mutate current state).
+        self.incarnation = 0
+        self.suspect_since = 0.0
+
+    @property
+    def alive(self) -> bool:
+        """Schedulable / trusted-for-accounting. SUSPECT nodes are frozen:
+        not schedulable, leases and actors kept but nothing new lands."""
+        return self.liveness == "ALIVE"
 
     def utilization(self) -> float:
         scores = []

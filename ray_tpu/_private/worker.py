@@ -457,6 +457,7 @@ class Worker:
                 *self.controller_addr,
                 on_push=self._on_ctrl_push,
                 on_close=self._on_ctrl_close,
+                label="ctrl",
             )
             rep = await self.controller.call(
                 "register", kind="client", worker_id=self.worker_id,
@@ -527,6 +528,7 @@ class Worker:
                     on_push=self._on_ctrl_push,
                     on_close=self._on_ctrl_close,
                     timeout=5,
+                    label="ctrl",
                 )
                 await conn.call(
                     "register", kind="client", worker_id=self.worker_id,
@@ -1752,7 +1754,8 @@ class _ActorPipe:
                     continue
                 conn = await rpc.connect(
                     *info["address"], on_push=self._on_push,
-                    on_close=self._on_close, timeout=10)
+                    on_close=self._on_close, timeout=10,
+                    label="actor-pipe")
                 # A new worker may have reused a dead worker's port while the
                 # controller still reports the old instance ALIVE: verify
                 # identity before trusting the link.

@@ -102,6 +102,13 @@ class Cluster:
     def remove_node(self, node: _NodeHandle, allow_graceful: bool = False):
         node.proc.kill()
         node.proc.wait(timeout=10)
+        try:
+            # Explicit removal: skip the liveness suspicion grace window
+            # (the kill is a fact, not a blip) so dependent failure
+            # handling (actor restarts, object loss) runs immediately.
+            self._call("kill_node", node_id=node.node_id)
+        except Exception:
+            pass
         self._wait_node_state(node.node_id, alive=False)
         self.nodes.remove(node)
 

@@ -83,7 +83,16 @@ class GenStream:
         return item
 
     def next(self, timeout: Optional[float] = None):
-        item = self._q.get(timeout=timeout)
+        try:
+            item = self._q.get(timeout=timeout)
+        except queue.Empty:
+            from ray_tpu.exceptions import GetTimeoutError
+
+            # Match ObjectRefGenerator.next: a timeout is a typed runtime
+            # error carrying the request identity, not a bare queue.Empty.
+            raise GetTimeoutError(
+                f"request {self.request_id} yielded no token within "
+                f"{timeout}s") from None
         if item is GenStream._DONE:
             self._q.put(GenStream._DONE)
             raise StopIteration
@@ -332,8 +341,6 @@ class ContinuousEngine:
     def submit(self, prompt_tokens, sampling: Optional[SamplingParams] = None
                ) -> GenStream:
         """Queue one request; returns its token stream immediately."""
-        if not self._running:
-            raise RuntimeError("engine is shut down")
         sampling = sampling or SamplingParams()
         prompt = np.asarray(prompt_tokens, np.int32).reshape(-1)
         if len(prompt) == 0:
@@ -343,8 +350,14 @@ class ContinuousEngine:
                 f"prompt ({len(prompt)}) + max_tokens ({sampling.max_tokens}) "
                 f"exceeds max_seq ({self.cfg.max_seq})")
         stream = GenStream(next(self._req_counter), len(prompt))
-        self._pending.put((prompt, sampling, stream))
+        # The _running check and the enqueue must be ONE atomic step
+        # against shutdown()'s flag flip: a submit that slips between the
+        # check and the put could otherwise queue a stream after the
+        # scheduler's final drain — stranding it without _DONE forever.
         with self._lock:
+            if not self._running:
+                raise RuntimeError("engine is shut down")
+            self._pending.put((prompt, sampling, stream))
             self._lock.notify_all()
         return stream
 
@@ -355,10 +368,22 @@ class ContinuousEngine:
         return [s.tokens() for s in streams]
 
     def shutdown(self):
-        self._running = False
         with self._lock:
+            self._running = False
             self._lock.notify_all()
         self._thread.join(timeout=10)
+        # Belt and braces after the join: the scheduler thread drains
+        # _pending on exit, but if the join timed out (thread wedged in a
+        # device call) any queued streams would hang their consumers —
+        # terminate them here. Safe against the loop's own drain (done
+        # markers are idempotent) because no new submit can enqueue after
+        # the flag flipped under the lock.
+        while True:
+            try:
+                _p, _s, stream = self._pending.get_nowait()
+            except queue.Empty:
+                break
+            stream._q.put(GenStream._DONE)
 
     @property
     def num_active(self) -> int:

@@ -237,7 +237,7 @@ def cmd_status(args) -> int:
     info = _rpc_call(address, "cluster_info")
     print(f"cluster {address} (session {info['session'][:8]})")
     for nid, n in snap["nodes"].items():
-        state = "ALIVE" if n["alive"] else "DEAD"
+        state = n.get("liveness") or ("ALIVE" if n["alive"] else "DEAD")
         print(f"  node {nid[:8]} {state} total={n['total']} available={n['available']}")
     actors = snap.get("actors", {})
     alive_actors = sum(1 for a in actors.values() if a.get("state") != "DEAD")

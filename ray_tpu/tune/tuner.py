@@ -120,7 +120,8 @@ class TuneController:
     def __init__(self, trainable: Callable, configs: list[dict],
                  tune_config: TuneConfig, run_config: RunConfig,
                  exp_dir: str, param_space: Optional[dict] = None,
-                 trials: Optional[list] = None):
+                 trials: Optional[list] = None,
+                 searcher_pre_observed: bool = False):
         self.trainable = trainable
         self.tc = tune_config
         self.rc = run_config
@@ -136,12 +137,17 @@ class TuneController:
         if self.searcher is not None:
             self.searcher.set_search_properties(
                 tune_config.metric, tune_config.mode, self.param_space)
-            # Feed restored finished trials back into the model (no-op for
-            # fresh runs; Tuner.restore currently rebuilds without a
-            # searcher, but a caller wiring one explicitly gets the data).
-            for t in self.trials:
-                if t.status == TERMINATED and t.last_result:
-                    self.searcher.observe(t.config, t.last_result)
+            # Feed restored finished trials back into the model — but ONLY
+            # when the searcher did not arrive via the pickled tune_config
+            # (Tuner.restore): that searcher's internal state already
+            # contains these observations, and replaying them would
+            # double-count each result and skew e.g. the TPE quantile
+            # split. The replay exists for callers who wire a FRESH
+            # searcher to restored trials.
+            if not searcher_pre_observed:
+                for t in self.trials:
+                    if t.status == TERMINATED and t.last_result:
+                        self.searcher.observe(t.config, t.last_result)
         self.scheduler = tune_config.scheduler or sched_mod.FIFOScheduler()
         self.scheduler.setup(tune_config.metric, tune_config.mode)
         self._futures: dict = {}  # next_result future -> (trial, runner)
@@ -400,6 +406,9 @@ class Tuner:
         self._run_config = run_config or RunConfig()
         self._restored_trials = _restored_trials
         self._exp_dir = _exp_dir
+        # True when restore() unpickled the tune_config: its searcher's
+        # state already includes every finished trial's observation.
+        self._searcher_from_pickle = False
 
     @classmethod
     def restore(cls, path: str, trainable=None) -> "Tuner":
@@ -441,9 +450,11 @@ class Tuner:
                             num_samples=state.get("num_samples", len(trials)))
         rc = (cloudpickle.loads(bytes.fromhex(state["run_config"]))
               if state.get("run_config") else RunConfig())
-        return cls(trainable, param_space=param_space, tune_config=tc,
-                   run_config=rc, _restored_trials=trials,
-                   _exp_dir=path)
+        tuner = cls(trainable, param_space=param_space, tune_config=tc,
+                    run_config=rc, _restored_trials=trials,
+                    _exp_dir=path)
+        tuner._searcher_from_pickle = bool(state.get("tune_config"))
+        return tuner
 
     def fit(self) -> ResultGrid:
         tc = self._tune_config
@@ -463,7 +474,8 @@ class Tuner:
         controller = TuneController(self._trainable, configs, tc,
                                     self._run_config, exp_dir,
                                     param_space=self._param_space,
-                                    trials=self._restored_trials)
+                                    trials=self._restored_trials,
+                                    searcher_pre_observed=self._searcher_from_pickle)
         controller.save_experiment_state()
         trials = controller.run()
         results = [

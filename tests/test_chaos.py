@@ -1,0 +1,337 @@
+"""Deterministic chaos tests: node-liveness suspicion + incarnation fencing.
+
+Parity target: reference GCS node-failure semantics — a transient raylet
+connection loss does NOT declare the node dead (health checks tolerate a
+reconnect window), and registration epochs fence messages from a node's
+previous life. The rpc.FaultInjector severs/drops frames on named
+connection classes so the blips are reproducible in-process:
+
+- a controller<->agent blip SHORTER than the suspicion grace window must
+  produce ZERO duplicate actor instances (the actor's direct pipe serves
+  uninterrupted across the blip);
+- a blip LONGER than the window runs the existing death/restart path, and
+  a late-returning zombie instance is reaped;
+- a stale-incarnation agent message is rejected and logged.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import rpc
+from ray_tpu._private.ids import NodeID
+from ray_tpu._private.resources import ResourceSet
+
+
+def _spawn_agent(controller_addr: str, session: str, num_cpus=2):
+    env = dict(os.environ)
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    driver_paths = [p for p in sys.path if p and os.path.exists(p)]
+    env["PYTHONPATH"] = os.pathsep.join([pkg_root] + driver_paths)
+    node_id = NodeID.from_random().hex()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.node_agent",
+         "--controller", controller_addr,
+         "--node-id", node_id,
+         "--session", session,
+         "--resources",
+         json.dumps(ResourceSet({"CPU": float(num_cpus)}).raw())],
+        env=env)
+    return node_id, proc
+
+
+def _snapshot():
+    return ray_tpu._private.worker.global_worker().state_snapshot()
+
+
+def _wait(pred, timeout: float, what: str):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.05)
+    raise TimeoutError(f"timed out waiting for {what}")
+
+
+def _controller():
+    return ray_tpu._head.controller
+
+
+def _start_chaos_cluster(grace: float, agents: int = 1, agent_cpus=2):
+    """In-process head (0 CPUs, so work lands on the agents) + subprocess
+    agents whose controller connections the injector can sever."""
+    ray_tpu.init(num_cpus=0, _system_config={
+        "fault_injection": True,
+        "node_suspect_grace_s": grace,
+    })
+    head = ray_tpu._head
+    addr = f"{head.controller_addr[0]}:{head.controller_addr[1]}"
+    spawned = [_spawn_agent(addr, head.session_id, num_cpus=agent_cpus)
+               for _ in range(agents)]
+    for nid, _proc in spawned:
+        _wait(lambda: (_snapshot()["nodes"].get(nid) or {}).get("alive"),
+              60, f"node {nid[:8]} to register")
+    return spawned
+
+
+@pytest.fixture
+def chaos_cleanup():
+    procs = []
+    yield procs
+    try:
+        ray_tpu.shutdown()
+    except Exception:
+        pass
+    for proc in procs:
+        try:
+            proc.kill()
+        except Exception:
+            pass
+    inj = rpc.fault_injector()
+    if inj is not None:
+        inj.clear()
+    rpc.disable_fault_injection()
+
+
+@ray_tpu.remote(num_cpus=1, max_restarts=1)
+class Counter:
+    def __init__(self):
+        self.n = 0
+        import time as _t
+
+        self.born = _t.time()
+
+    def bump(self):
+        self.n += 1
+        return self.n
+
+    def ident(self):
+        return {"pid": os.getpid(), "node": os.environ.get("RT_NODE_ID")}
+
+
+def test_conn_blip_shorter_than_grace_no_duplicate_actor(chaos_cleanup):
+    """Sever the controller<->agent link, let the agent reconnect within
+    the grace window: the node goes SUSPECT and back to ALIVE, the actor is
+    never restarted, and its pipe serves calls throughout the blip."""
+    spawned = _start_chaos_cluster(grace=8.0)
+    chaos_cleanup.extend(p for _n, p in spawned)
+    nid, _proc = spawned[0]
+
+    a = Counter.remote()
+    assert ray_tpu.get(a.bump.remote(), timeout=60) == 1
+    before = ray_tpu.get(a.ident.remote(), timeout=60)
+    assert before["node"] == nid
+    ctrl = _controller()
+    ent = ctrl.actors[a._actor_id]
+    instance_before = ent.instance
+    inc_before = _snapshot()["nodes"][nid]["incarnation"]
+
+    inj = rpc.fault_injector()
+    assert inj is not None
+    n = inj.sever("node", match=lambda c: c.meta.get("node_id") == nid)
+    assert n == 1
+
+    # The node goes SUSPECT (frozen, unschedulable, actor NOT restarted)
+    # until the agent's reconnect lands as a new incarnation.
+    def _blipped():
+        n = _snapshot()["nodes"][nid]
+        return n["liveness"] == "SUSPECT" or n["incarnation"] > inc_before
+
+    _wait(_blipped, 10, "node to enter SUSPECT")
+
+    # The actor's direct pipe never touched the severed link: calls keep
+    # working DURING the blip.
+    assert ray_tpu.get(a.bump.remote(), timeout=30) == 2
+
+    # Agent reconnects within grace: node returns ALIVE as a new
+    # incarnation, reconciled in place.
+    _wait(lambda: _snapshot()["nodes"][nid]["alive"]
+          and _snapshot()["nodes"][nid]["incarnation"] > inc_before,
+          30, "node to reconcile back to ALIVE")
+
+    after = ray_tpu.get(a.ident.remote(), timeout=60)
+    snap = _snapshot()
+    assert after["pid"] == before["pid"], "duplicate actor instance spawned"
+    assert snap["actors"][a._actor_id]["state"] == "ALIVE"
+    assert snap["actors"][a._actor_id]["restarts_used"] == 0
+    assert ent.instance == instance_before
+    # State survived: the counter kept its increments across the blip.
+    assert ray_tpu.get(a.bump.remote(), timeout=30) == 3
+    # New work schedules on the reconciled node again.
+    @ray_tpu.remote(num_cpus=1)
+    def where():
+        return os.environ.get("RT_NODE_ID")
+
+    assert ray_tpu.get(where.remote(), timeout=60) == nid
+
+    # kill() DURING a blip cannot reach the agent; the reconcile's
+    # inventory sweep must reap the zombie instance once the node returns.
+    b = Counter.remote()
+    b_pid = ray_tpu.get(b.ident.remote(), timeout=60)["pid"]
+    inc2 = _snapshot()["nodes"][nid]["incarnation"]
+    assert inj.sever("node", match=lambda c: c.meta.get("node_id") == nid) == 1
+    ray_tpu.kill(b)
+    _wait(lambda: _snapshot()["nodes"][nid]["alive"]
+          and _snapshot()["nodes"][nid]["incarnation"] > inc2,
+          30, "node to reconcile after second blip")
+
+    def _killed_instance_gone():
+        try:
+            os.kill(b_pid, 0)
+            return False
+        except OSError:
+            return True
+
+    _wait(_killed_instance_gone, 20, "kill()ed-during-blip zombie to be reaped")
+
+
+def test_conn_blip_during_actor_creation(chaos_cleanup):
+    """Blip while an actor's __init__ is still running on the node: the
+    creation completes through the outage (the worker reports on its own
+    connection) and exactly one instance exists afterwards."""
+    spawned = _start_chaos_cluster(grace=10.0)
+    chaos_cleanup.extend(p for _n, p in spawned)
+    nid, _proc = spawned[0]
+
+    @ray_tpu.remote(num_cpus=1, max_restarts=1)
+    class Slow:
+        def __init__(self):
+            import time as _t
+
+            _t.sleep(2.5)
+            self.pid = os.getpid()
+
+        def ident(self):
+            return self.pid
+
+    s = Slow.remote()
+    ctrl = _controller()
+    # Deterministic cut point: the creation was dispatched (worker bound)
+    # but __init__ has not finished.
+    _wait(lambda: ctrl.actors[s._actor_id].worker_id is not None,
+          60, "actor creation to dispatch")
+    assert ctrl.actors[s._actor_id].state == "PENDING"
+    inj = rpc.fault_injector()
+    assert inj.sever("node", match=lambda c: c.meta.get("node_id") == nid) == 1
+
+    pid = ray_tpu.get(s.ident.remote(), timeout=90)
+    assert pid == ray_tpu.get(s.ident.remote(), timeout=30)
+    snap = _snapshot()
+    assert snap["actors"][s._actor_id]["state"] == "ALIVE"
+    assert snap["actors"][s._actor_id]["restarts_used"] == 0
+    _wait(lambda: _snapshot()["nodes"][nid]["alive"], 30,
+          "node to reconcile back to ALIVE")
+
+
+def test_conn_blip_longer_than_grace_runs_death_path(chaos_cleanup):
+    """Keep the agent out past the grace window (its re-register frame is
+    dropped once): the node is promoted SUSPECT -> DEAD, the actor restarts
+    on the surviving node, and when the original agent finally returns its
+    stale instance is reaped — exactly one instance lives."""
+    spawned = _start_chaos_cluster(grace=1.5, agents=2)
+    chaos_cleanup.extend(p for _n, p in spawned)
+
+    a = Counter.remote()
+    assert ray_tpu.get(a.bump.remote(), timeout=60) == 1
+    before = ray_tpu.get(a.ident.remote(), timeout=60)
+    host_nid = before["node"]
+    other_nid = next(n for n, _p in spawned if n != host_nid)
+
+    inj = rpc.fault_injector()
+    # The agent reconnects in ~0.5s — well inside the window. Sever its
+    # next few re-register attempts (each fails fast and retries 0.5s
+    # later), keeping the node out past the 1.5s grace.
+    inj.add_rule(
+        None, "sever", direction="recv", methods={"register"}, times=4,
+        match=lambda m: (m.get("a") or {}).get("kind") == "node"
+        and m["a"].get("node_id") == host_nid)
+    assert inj.sever(
+        "node", match=lambda c: c.meta.get("node_id") == host_nid) == 1
+
+    # Grace expires -> death path: the actor restarts on the OTHER node.
+    _wait(lambda: _snapshot()["actors"][a._actor_id]["restarts_used"] == 1,
+          30, "actor to restart after grace expiry")
+    _wait(lambda: _snapshot()["actors"][a._actor_id]["state"] == "ALIVE",
+          60, "restarted actor to come up")
+
+    # The original agent eventually re-registers (fresh node incarnation)
+    # and its resurfaced stale instance gets killed: the old pid dies.
+    # (Until then the driver's existing pipe still points at the zombie —
+    # the reap is what collapses the split brain.)
+    _wait(lambda: (_snapshot()["nodes"].get(host_nid) or {}).get("alive"),
+          60, "blipped agent to rejoin")
+
+    def _old_instance_gone():
+        try:
+            os.kill(before["pid"], 0)
+            return False
+        except OSError:
+            return True
+
+    _wait(_old_instance_gone, 30, "zombie actor instance to be reaped")
+
+    # With the zombie gone, the handle re-resolves to the restarted
+    # instance: fresh pid on the surviving node, fresh in-memory state.
+    after = ray_tpu.get(a.ident.remote(), timeout=60)
+    assert after["node"] == other_nid
+    assert after["pid"] != before["pid"]
+    assert ray_tpu.get(a.bump.remote(), timeout=30) == 1
+
+
+def test_stale_incarnation_message_rejected(chaos_cleanup):
+    """A zombie agent from a previous life of a node pushes heartbeats and
+    worker_died with its old incarnation: the controller rejects and logs
+    them, and the old connection's close is not a liveness event for the
+    current life."""
+    ray_tpu.init(num_cpus=1, _system_config={
+        "fault_injection": True,
+        "node_suspect_grace_s": 5.0,
+    })
+    ctrl = _controller()
+    addr = ray_tpu._head.controller_addr
+    io = rpc.EventLoopThread(name="zombie-io")
+    nid = "zombie" + NodeID.from_random().hex()[:8]
+    try:
+        async def _register():
+            conn = await rpc.connect(*addr)
+            rep = await conn.call(
+                "register", kind="node", node_id=nid,
+                address=("127.0.0.1", 1), resources={}, labels={})
+            return conn, rep["incarnation"]
+
+        old_conn, old_inc = io.run(_register(), timeout=30)
+        assert old_inc == ctrl.node_incarnations[nid]
+        new_conn, new_inc = io.run(_register(), timeout=30)
+        assert new_inc == old_inc + 1
+
+        rejected_before = ctrl.stale_incarnation_rejections
+        io.run(old_conn.push("heartbeat", node_id=nid, incarnation=old_inc))
+        io.run(old_conn.push("worker_died", worker_id="w" * 16,
+                             node_id=nid, incarnation=old_inc))
+        _wait(lambda: ctrl.stale_incarnation_rejections >= rejected_before + 2,
+              10, "stale-incarnation messages to be rejected")
+
+        # A current-incarnation heartbeat is accepted (no new rejections).
+        count = ctrl.stale_incarnation_rejections
+        io.run(new_conn.push("heartbeat", node_id=nid, incarnation=new_inc))
+        time.sleep(0.3)
+        assert ctrl.stale_incarnation_rejections == count
+        beat_before = ctrl.nodes[nid].last_beat
+        io.run(old_conn.push("heartbeat", node_id=nid, incarnation=old_inc))
+        time.sleep(0.3)
+        assert ctrl.nodes[nid].last_beat == beat_before, \
+            "stale heartbeat refreshed liveness"
+
+        # The PREVIOUS life's connection closing must not suspect/kill the
+        # current life.
+        io.run(old_conn.close(), timeout=10)
+        time.sleep(0.5)
+        assert ctrl.nodes[nid].liveness == "ALIVE"
+        assert ctrl.nodes[nid].incarnation == new_inc
+    finally:
+        io.stop()
