@@ -7,6 +7,10 @@ release/perf_metrics/microbenchmark.json (BASELINE.md), measured on a
 64-vcpu m4.16xlarge; this runs wherever the driver puts it (often 1 vcpu),
 so vs_baseline carries the hardware gap as well.
 
+`--smoke` runs only the tasks/actors/objects microbenches with short timing
+windows (sub-30s, no TPU / LLM / RLlib sections) — the CI perf gate
+(tests/test_perf_smoke.py, `perf` marker, outside the tier-1 budget).
+
 Prints ONE JSON line on stdout:
   {"metric": "microbench_geomean", "value": <geomean of per-metric ratios
    vs baseline>, "unit": "x_baseline", "vs_baseline": ..., "details": {...}}
@@ -43,6 +47,8 @@ TPU_PEAK_BF16 = {
     "TPU v6 lite": 918e12, "TPU v6e": 918e12,
 }
 
+MIN_TIME = 2.0  # per-bench timing window; --smoke shrinks it
+
 
 def tpu_peak_flops(dev) -> tuple[float | None, str]:
     kind = getattr(dev, "device_kind", "") or ""
@@ -56,8 +62,10 @@ def log(msg):
     print(msg, file=sys.stderr, flush=True)
 
 
-def timeit(name, fn, multiplier=1, min_time=2.0):
+def timeit(name, fn, multiplier=1, min_time=None):
     """reference ray_perf.py timeit: run fn repeatedly, report ops/s."""
+    if min_time is None:
+        min_time = MIN_TIME
     fn()  # warmup
     start = time.perf_counter()
     count = 0
@@ -70,11 +78,38 @@ def timeit(name, fn, multiplier=1, min_time=2.0):
     return rate
 
 
-def main():
+def _transport_info() -> str:
+    """Which same-host transport the cluster actually selected: workers
+    reach the controller via a unix socket when the private socket dir is
+    usable, else loopback TCP (on which asyncio sets TCP_NODELAY and
+    rpc.connect re-asserts it). In local mode the driver itself rides the
+    in-process LocalConnection either way."""
+    try:
+        import ray_tpu
+        from ray_tpu._private import rpc as _rpc
+
+        port = ray_tpu._head.controller_addr[1]
+        path = _rpc._uds_path(port)
+        if path is not None and os.path.exists(path):
+            return "uds"
+        return "tcp+nodelay"
+    except Exception:
+        return "unknown"
+
+
+def main(smoke: bool = False):
+    global MIN_TIME
+    if smoke:
+        MIN_TIME = min(MIN_TIME, 0.5)
     import ray_tpu
 
     ray_tpu.init(num_cpus=4)
     results: dict[str, float] = {}
+    extra_details: dict = {}
+
+    transport = _transport_info()
+    extra_details["transport"] = transport
+    log(f"transport: same-host object/control plane via {transport}")
 
     @ray_tpu.remote
     def noop():
@@ -182,7 +217,48 @@ def main():
     results["single_client_put_gigabytes"] = timeit(
         "single client put gigabytes", put_big, multiplier=gb)
 
-    # ---- compiled-graph channel round-trip (native futex ring) -----------
+    if not smoke:
+        _bench_channel(results)
+        _bench_tpu_matmul(results, extra_details)
+        _bench_flash_attention(results, extra_details)
+        _bench_llm_decode(results)
+        _bench_rllib_ppo(results)
+
+    ray_tpu.shutdown()
+
+    ratios = {k: results[k] / BASELINES[k] for k in BASELINES if k in results}
+    # put-GB/s is bounded by this host's memcpy bandwidth (one mandatory
+    # copy into shm); the 19.4 GB/s baseline box had ~4x this box's memory
+    # bandwidth. Judge the metric against the reachable ceiling and record
+    # both numbers (raw ratio kept in details as put_gigabytes_raw_ratio).
+    put_raw_ratio = None
+    if "single_client_put_gigabytes" in ratios:
+        put_raw_ratio = ratios["single_client_put_gigabytes"]
+        capped_baseline = min(BASELINES["single_client_put_gigabytes"], hw_memcpy)
+        ratios["single_client_put_gigabytes"] = (
+            results["single_client_put_gigabytes"] / capped_baseline)
+        log(f"  (put GB/s judged vs min(baseline, memcpy ceiling)="
+            f"{capped_baseline:.1f} GB/s; raw ratio {put_raw_ratio:.3f})")
+    geomean = float(np.exp(np.mean([np.log(max(r, 1e-9)) for r in ratios.values()])))
+    details = {k: round(v, 1) for k, v in results.items()}
+    details["hw_memcpy_gbps"] = round(hw_memcpy, 1)
+    details["ratios"] = {k: round(r, 3) for k, r in ratios.items()}
+    if put_raw_ratio is not None:
+        details["put_gigabytes_raw_ratio"] = round(put_raw_ratio, 3)
+    if smoke:
+        details["smoke"] = True
+    details.update(extra_details)
+    print(json.dumps({
+        "metric": "microbench_geomean",
+        "value": round(geomean, 4),
+        "unit": "x_baseline",
+        "vs_baseline": round(geomean, 4),
+        "details": details,
+    }), flush=True)
+
+
+# ---- compiled-graph channel round-trip (native futex ring) ---------------
+def _bench_channel(results: dict):
     try:
         import multiprocessing as mp
         import time as _time
@@ -228,177 +304,214 @@ def main():
     except Exception as e:
         log(f"  channel bench skipped: {e}")
 
-    # ---- TPU matmul MFU (single chip), when a TPU is reachable -----------
-    mfu = None
+
+# ---- TPU matmul MFU (single chip), when a TPU is reachable ---------------
+def _bench_tpu_matmul(results: dict, details: dict):
     try:
         import jax
         import jax.numpy as jnp
 
-        if jax.devices()[0].platform == "tpu":
-            n = 4096
-            x = jax.random.normal(jax.random.PRNGKey(0), (n, n),
-                                  dtype=jnp.bfloat16) / (n ** 0.5)
+        if jax.devices()[0].platform != "tpu":
+            return
+        n = 4096
+        x = jax.random.normal(jax.random.PRNGKey(0), (n, n),
+                              dtype=jnp.bfloat16) / (n ** 0.5)
 
-            def chain(a, iters):
-                # lax.fori_loop keeps the whole chain in ONE device program
-                # and only a scalar comes back: the long-vs-short slope
-                # isolates pure matmul time even over a slow tunnel.
-                y = jax.lax.fori_loop(0, iters, lambda i, y: y @ x, a)
-                return jnp.float32(y.sum())
+        def chain(a, iters):
+            # lax.fori_loop keeps the whole chain in ONE device program
+            # and only a scalar comes back: the long-vs-short slope
+            # isolates pure matmul time even over a slow tunnel.
+            y = jax.lax.fori_loop(0, iters, lambda i, y: y @ x, a)
+            return jnp.float32(y.sum())
 
-            f = jax.jit(chain, static_argnums=1)
+        f = jax.jit(chain, static_argnums=1)
 
-            def run(iters):
-                t0 = time.perf_counter()
-                float(f(x, iters))  # scalar materialization
-                return time.perf_counter() - t0
+        def run(iters):
+            t0 = time.perf_counter()
+            float(f(x, iters))  # scalar materialization
+            return time.perf_counter() - t0
 
-            run(2)  # compile both variants ahead of timing
-            run(130)
-            t_short = min(run(2) for _ in range(3))
-            t_long = min(run(130) for _ in range(3))
-            per_matmul = (t_long - t_short) / 128
-            flops = 2 * n**3 / per_matmul
-            results["tpu_matmul_tflops"] = flops / 1e12
-            peak, kind = tpu_peak_flops(jax.devices()[0])
-            if peak is not None:
-                mfu = flops / peak
-                log(f"  tpu matmul: {flops/1e12:.1f} TFLOP/s "
-                    f"({mfu*100:.1f}% of {kind} bf16 peak)")
-            else:
-                log(f"  tpu matmul: {flops/1e12:.1f} TFLOP/s ({kind})")
+        run(2)  # compile both variants ahead of timing
+        run(130)
+        t_short = min(run(2) for _ in range(3))
+        t_long = min(run(130) for _ in range(3))
+        per_matmul = (t_long - t_short) / 128
+        if per_matmul <= 0:
+            details["tpu_matmul"] = {
+                "fallback": True,
+                "reason": "non-monotonic timing (link noise dominated)"}
+            log("  tpu matmul: timing unreliable (long chain not slower "
+                "than short); no TFLOP/s claimed")
+            return
+        flops = 2 * n**3 / per_matmul
+        results["tpu_matmul_tflops"] = flops / 1e12
+        peak, kind = tpu_peak_flops(jax.devices()[0])
+        if peak is not None:
+            mfu = flops / peak
+            details["tpu_matmul_mfu"] = round(mfu, 3)
+            log(f"  tpu matmul: {flops/1e12:.1f} TFLOP/s "
+                f"({mfu*100:.1f}% of {kind} bf16 peak)")
+        else:
+            log(f"  tpu matmul: {flops/1e12:.1f} TFLOP/s ({kind})")
     except Exception as e:  # no TPU in this environment
         log(f"  tpu matmul skipped: {e}")
 
-    # ---- Pallas flash attention TFLOP/s (single chip) --------------------
+
+# ---- Pallas flash attention TFLOP/s (single chip) ------------------------
+def _bench_flash_attention(results: dict, details: dict):
+    """Times the Pallas kernel directly. A shape rejection (ValueError) or
+    an unreliable timing window is reported as an explicit
+    {"fallback": true, "reason": ...} detail — never as a negative
+    TFLOP/s number polluting the results."""
     try:
         import jax
         import jax.numpy as jnp
 
-        if jax.devices()[0].platform == "tpu":
-            from ray_tpu.ops.flash_attention import flash_attention
+        if jax.devices()[0].platform != "tpu":
+            return
+        from ray_tpu.ops.flash_attention import flash_attention
 
-            b_, s_, h_, d_ = 4, 2048, 8, 128
-            key = jax.random.PRNGKey(0)
-            qa = jax.random.normal(key, (b_, s_, h_, d_), jnp.bfloat16)
-            ka = jax.random.normal(key, (b_, s_, h_, d_), jnp.bfloat16)
-            va = jax.random.normal(key, (b_, s_, h_, d_), jnp.bfloat16)
+        b_, s_, h_, d_ = 4, 2048, 8, 128
+        key = jax.random.PRNGKey(0)
+        qa = jax.random.normal(key, (b_, s_, h_, d_), jnp.bfloat16)
+        ka = jax.random.normal(key, (b_, s_, h_, d_), jnp.bfloat16)
+        va = jax.random.normal(key, (b_, s_, h_, d_), jnp.bfloat16)
 
-            def attn_chain(qx, iters):
-                def body(i, acc):
-                    return flash_attention(acc, ka, va, causal=True)
-                y = jax.lax.fori_loop(0, iters, body, qx)
-                return jnp.float32(y.astype(jnp.float32).sum())
+        def attn_chain(qx, iters):
+            def body(i, acc):
+                return flash_attention(acc, ka, va, causal=True)
+            y = jax.lax.fori_loop(0, iters, body, qx)
+            return jnp.float32(y.astype(jnp.float32).sum())
 
-            fa = jax.jit(attn_chain, static_argnums=1)
+        fa = jax.jit(attn_chain, static_argnums=1)
 
-            def run_a(iters):
-                t0 = time.perf_counter()
-                float(fa(qa, iters))
-                return time.perf_counter() - t0
+        def run_a(iters):
+            t0 = time.perf_counter()
+            float(fa(qa, iters))
+            return time.perf_counter() - t0
 
+        try:
             run_a(2)
-            run_a(34)
-            t_short = min(run_a(2) for _ in range(3))
-            t_long = min(run_a(34) for _ in range(3))
-            per_call = (t_long - t_short) / 32
-            # useful causal flops: 4*b*h*s^2*d * 1/2
-            aflops = 4 * b_ * h_ * s_ * s_ * d_ * 0.5 / per_call
-            results["flash_attention_tflops"] = aflops / 1e12
-            log(f"  flash attention: {aflops/1e12:.1f} TFLOP/s "
-                f"(causal, b{b_} s{s_} h{h_} d{d_})")
+        except ValueError as e:
+            # Kernel rejected the bench shape: an explicit fallback detail,
+            # not a bogus throughput number.
+            details["flash_attention"] = {"fallback": True, "reason": str(e)}
+            log(f"  flash attention: Pallas kernel rejected bench shape "
+                f"(b{b_} s{s_} h{h_} d{d_}): {e}")
+            return
+        run_a(34)
+        t_short = min(run_a(2) for _ in range(3))
+        t_long = min(run_a(34) for _ in range(3))
+        per_call = (t_long - t_short) / 32
+        if per_call <= 0:
+            details["flash_attention"] = {
+                "fallback": True,
+                "reason": "non-monotonic timing (link noise dominated)"}
+            log("  flash attention: timing unreliable (long chain not "
+                "slower than short); no TFLOP/s claimed")
+            return
+        # useful causal flops: 4*b*h*s^2*d * 1/2
+        aflops = 4 * b_ * h_ * s_ * s_ * d_ * 0.5 / per_call
+        results["flash_attention_tflops"] = aflops / 1e12
+        log(f"  flash attention: {aflops/1e12:.1f} TFLOP/s "
+            f"(causal, b{b_} s{s_} h{h_} d{d_})")
     except Exception as e:
         log(f"  flash attention skipped: {e}")
 
-    # ---- LLM continuous-batching decode throughput (single chip) ---------
+
+# ---- LLM continuous-batching decode throughput (single chip) -------------
+def _bench_llm_decode(results: dict):
     try:
         import jax
 
-        if jax.devices()[0].platform == "tpu":
-            from ray_tpu.llm import LLMConfig
-            from ray_tpu.llm.engine import ContinuousEngine, SamplingParams
+        if jax.devices()[0].platform != "tpu":
+            return
+        from ray_tpu.llm import LLMConfig
+        from ray_tpu.llm.engine import ContinuousEngine, SamplingParams
 
-            lcfg = LLMConfig(vocab_size=32000, d_model=1024, n_layers=8,
-                             n_heads=16, max_seq=1024, dtype="bfloat16")
-            eng = ContinuousEngine(lcfg, max_batch=8, decode_chunk=16)
-            rng = np.random.RandomState(0)
-            sp = SamplingParams(temperature=0.0, max_tokens=128)
+        lcfg = LLMConfig(vocab_size=32000, d_model=1024, n_layers=8,
+                         n_heads=16, max_seq=1024, dtype="bfloat16")
+        eng = ContinuousEngine(lcfg, max_batch=8, decode_chunk=16)
+        rng = np.random.RandomState(0)
+        sp = SamplingParams(temperature=0.0, max_tokens=128)
 
-            def churn(n_reqs):
-                """Mixed batch churn: staggered submits with varied prompt
-                lengths — requests join/leave the running batch (the
-                continuous-batching case, not lockstep generate)."""
-                streams = []
-                total = 0
-                for i in range(n_reqs):
-                    plen = int(rng.choice([64, 128, 256]))
-                    smp = SamplingParams(temperature=0.0,
-                                         max_tokens=96 + 16 * (i % 3))
-                    streams.append(eng.submit(
-                        rng.randint(0, 32000, size=plen), smp))
-                    total += smp.max_tokens
-                for s in streams:
-                    s.tokens()
-                return total
-
-            # Warm EVERY prefill bucket the timed churn can draw (each
-            # bucket is its own compiled program; one landing inside the
-            # timed window would corrupt the number), then a churn for the
-            # chunk-size programs.
-            warm = [eng.submit(np.random.randint(0, 32000, size=p),
-                               SamplingParams(temperature=0.0, max_tokens=8))
-                    for p in (64, 128, 256)]
-            for s in warm:
+        def churn(n_reqs):
+            """Mixed batch churn: staggered submits with varied prompt
+            lengths — requests join/leave the running batch (the
+            continuous-batching case, not lockstep generate)."""
+            streams = []
+            total = 0
+            for i in range(n_reqs):
+                plen = int(rng.choice([64, 128, 256]))
+                smp = SamplingParams(temperature=0.0,
+                                     max_tokens=96 + 16 * (i % 3))
+                streams.append(eng.submit(
+                    rng.randint(0, 32000, size=plen), smp))
+                total += smp.max_tokens
+            for s in streams:
                 s.tokens()
-            churn(8)  # warm: chunk sizes + admission interleavings
+            return total
+
+        # Warm EVERY prefill bucket the timed churn can draw (each
+        # bucket is its own compiled program; one landing inside the
+        # timed window would corrupt the number), then a churn for the
+        # chunk-size programs.
+        warm = [eng.submit(np.random.randint(0, 32000, size=p),
+                           SamplingParams(temperature=0.0, max_tokens=8))
+                for p in (64, 128, 256)]
+        for s in warm:
+            s.tokens()
+        churn(8)  # warm: chunk sizes + admission interleavings
+        t0 = time.perf_counter()
+        total = churn(16)
+        dt = time.perf_counter() - t0
+        churn_tps = total / dt
+        # Steady-state decode: chunks chained ON DEVICE, one readback —
+        # the decode-throughput number (the r04 methodology measured a
+        # single whole-generation scan the same way). The churn number
+        # above additionally pays scheduler syncs, whose cost is the
+        # HOST-LINK latency (hundreds of ms through a tunneled TPU,
+        # ~1ms co-located).
+        import jax.numpy as jnp
+
+        cache = eng._init_cache()
+        toks = jnp.zeros(8, jnp.int32)
+        lens = jnp.full(8, 200, jnp.int32)
+        zf = jnp.zeros(8, jnp.float32)
+        zi = jnp.zeros(8, jnp.int32)
+        of = jnp.ones(8, jnp.float32)
+
+        def chain(n_chunks):
+            nonlocal cache, toks, lens
+            c, t, l = cache, toks, lens
+            outs = []
+            for _ in range(n_chunks):
+                c, _k, out, l = eng._chunk(
+                    eng.params, c, t, l, eng._keys, zf, zi, of, 16, True)
+                t = out[:, -1]
+                outs.append(out)
             t0 = time.perf_counter()
-            total = churn(16)
+            np.asarray(jnp.concatenate(outs, axis=1))
             dt = time.perf_counter() - t0
-            churn_tps = total / dt
-            # Steady-state decode: chunks chained ON DEVICE, one readback —
-            # the decode-throughput number (the r04 methodology measured a
-            # single whole-generation scan the same way). The churn number
-            # above additionally pays scheduler syncs, whose cost is the
-            # HOST-LINK latency (hundreds of ms through a tunneled TPU,
-            # ~1ms co-located).
-            import jax.numpy as jnp
+            cache, toks, lens = c, t, l  # chunk donates its cache input
+            return dt
 
-            cache = eng._init_cache()
-            toks = jnp.zeros(8, jnp.int32)
-            lens = jnp.full(8, 200, jnp.int32)
-            zf = jnp.zeros(8, jnp.float32)
-            zi = jnp.zeros(8, jnp.int32)
-            of = jnp.ones(8, jnp.float32)
-
-            def chain(n_chunks):
-                nonlocal cache, toks, lens
-                c, t, l = cache, toks, lens
-                outs = []
-                for _ in range(n_chunks):
-                    c, _k, out, l = eng._chunk(
-                        eng.params, c, t, l, eng._keys, zf, zi, of, 16, True)
-                    t = out[:, -1]
-                    outs.append(out)
-                t0 = time.perf_counter()
-                np.asarray(jnp.concatenate(outs, axis=1))
-                dt = time.perf_counter() - t0
-                cache, toks, lens = c, t, l  # chunk donates its cache input
-                return dt
-
-            chain(1)
-            t2 = min(chain(2) for _ in range(2))
-            t10 = min(chain(10) for _ in range(2))
-            per_step = max(1e-9, (t10 - t2) / (8 * 16))
-            tps = 8 / per_step
-            results["llm_decode_tokens_per_s"] = tps
-            log(f"  llm decode: {tps:,.0f} tok/s steady (continuous-batch "
-                f"engine, b8, bf16, 1024d x 8L; end-to-end churn with "
-                f"host-link syncs: {churn_tps:,.0f} tok/s)")
-            eng.shutdown()
+        chain(1)
+        t2 = min(chain(2) for _ in range(2))
+        t10 = min(chain(10) for _ in range(2))
+        per_step = max(1e-9, (t10 - t2) / (8 * 16))
+        tps = 8 / per_step
+        results["llm_decode_tokens_per_s"] = tps
+        log(f"  llm decode: {tps:,.0f} tok/s steady (continuous-batch "
+            f"engine, b8, bf16, 1024d x 8L; end-to-end churn with "
+            f"host-link syncs: {churn_tps:,.0f} tok/s)")
+        eng.shutdown()
     except Exception as e:
         log(f"  llm decode skipped: {e}")
 
-    # ---- RLlib PPO env-steps/sec (BASELINE north-star workload) ----------
+
+# ---- RLlib PPO env-steps/sec (BASELINE north-star workload) --------------
+def _bench_rllib_ppo(results: dict):
     try:
         from ray_tpu.rllib import PPOConfig
 
@@ -417,37 +530,13 @@ def main():
     except Exception as e:
         log(f"  rllib ppo skipped: {e}")
 
-    ray_tpu.shutdown()
-
-    ratios = {k: results[k] / BASELINES[k] for k in BASELINES if k in results}
-    # put-GB/s is bounded by this host's memcpy bandwidth (one mandatory
-    # copy into shm); the 19.4 GB/s baseline box had ~4x this box's memory
-    # bandwidth. Judge the metric against the reachable ceiling and record
-    # both numbers (raw ratio kept in details as put_gigabytes_raw_ratio).
-    put_raw_ratio = None
-    if "single_client_put_gigabytes" in ratios:
-        put_raw_ratio = ratios["single_client_put_gigabytes"]
-        capped_baseline = min(BASELINES["single_client_put_gigabytes"], hw_memcpy)
-        ratios["single_client_put_gigabytes"] = (
-            results["single_client_put_gigabytes"] / capped_baseline)
-        log(f"  (put GB/s judged vs min(baseline, memcpy ceiling)="
-            f"{capped_baseline:.1f} GB/s; raw ratio {put_raw_ratio:.3f})")
-    geomean = float(np.exp(np.mean([np.log(max(r, 1e-9)) for r in ratios.values()])))
-    details = {k: round(v, 1) for k, v in results.items()}
-    details["hw_memcpy_gbps"] = round(hw_memcpy, 1)
-    details["ratios"] = {k: round(r, 3) for k, r in ratios.items()}
-    if put_raw_ratio is not None:
-        details["put_gigabytes_raw_ratio"] = round(put_raw_ratio, 3)
-    if mfu is not None:
-        details["tpu_matmul_mfu"] = round(mfu, 3)
-    print(json.dumps({
-        "metric": "microbench_geomean",
-        "value": round(geomean, 4),
-        "unit": "x_baseline",
-        "vs_baseline": round(geomean, 4),
-        "details": details,
-    }), flush=True)
-
 
 if __name__ == "__main__":
-    main()
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="tasks/actors/objects only, short windows (<30s), "
+                         "no TPU/LLM/RLlib sections")
+    args = ap.parse_args()
+    main(smoke=args.smoke)

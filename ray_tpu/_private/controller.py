@@ -132,7 +132,7 @@ class Controller:
         # task_id -> (task_done payload, expiry): completions whose task_done
         # beat the dispatch *reply* (worker reports straight to the
         # controller; the agent's reply rides another connection). Replayed
-        # by _dispatch_bg once the dispatch bookkeeping exists — otherwise
+        # by _dispatched once the dispatch bookkeeping exists — otherwise
         # the late-arriving entry would zombify and leak its resources.
         self.early_done: dict[str, tuple[dict, float]] = {}
         self._sched_wakeup = asyncio.Event()
@@ -156,6 +156,14 @@ class Controller:
         # reaper must recognize an actor owner even after its entry's
         # worker_id was cleared by the death bookkeeping.
         self._actor_host_workers: set[str] = set()
+        # task_id -> (spec, demand, nid): specs sent in a dispatch_batch
+        # whose per-spec `dispatched` push hasn't landed yet. Entries left
+        # after the batch call resolves (agent/conn death) are requeued.
+        self._pending_dispatch: dict[str, tuple] = {}
+        # owner worker_id -> buffered object_ready items: completions are
+        # notified in batched `objects_ready` frames (one per owner per
+        # event-loop burst) instead of one push per oid.
+        self._ready_bufs: dict[str, list] = {}
         # node_id -> latest minted incarnation. Survives the NodeState
         # (incremented across SUSPECT->DEAD->rejoin), so a zombie agent
         # from ANY previous life is fenced, not just the last one.
@@ -636,7 +644,9 @@ class Controller:
 
     async def _schedule_once(self):
         # Single pass over the queue; tasks that can't be placed stay queued.
-        # Dispatch RPCs run concurrently (ensure_future) so one node's slow
+        # Placements are grouped per node and dispatched as ONE batched RPC
+        # per node per pass (the agent fans out worker acquisition
+        # internally), run concurrently (ensure_future) so one node's slow
         # worker acquisition cannot stall cluster-wide placement (the agent
         # may wait up to worker_register_timeout_s for a free worker).
         still_pending: deque[TaskSpec] = deque()
@@ -645,6 +655,7 @@ class Controller:
         # pick_node scan (reference caches by SchedulingClass; keeps a burst
         # of N queued tasks from costing O(N) scans per completion).
         failed_sigs: set = set()
+        by_node: dict[str, list] = {}  # nid -> [(spec, demand)]
         while self.pending:
             spec = self.pending.popleft()
             if self._consume_cancel(spec.task_id) is not None:
@@ -664,8 +675,10 @@ class Controller:
                 still_pending.append(spec)
                 continue
             self._consume(nid, spec, demand)
-            asyncio.ensure_future(self._dispatch_bg(nid, spec, demand))
+            by_node.setdefault(nid, []).append((spec, demand))
         self.pending.extend(still_pending)
+        for nid, items in by_node.items():
+            asyncio.ensure_future(self._dispatch_batch_bg(nid, items))
         if still_pending:
             self._maybe_push_need_resources()
 
@@ -698,13 +711,84 @@ class Controller:
                     out[nid] = out.get(nid, 0) + ent.size
         return out
 
-    async def _dispatch_bg(self, nid: str, spec: TaskSpec, demand: ResourceSet):
-        ok = await self._dispatch(nid, spec)
-        if not ok:
+    async def _dispatch_batch_bg(self, nid: str, items: list):
+        """One `dispatch_batch` RPC carries every spec this scheduling pass
+        placed on `nid` (O(1) frames per hop for an async burst of N
+        tasks). The agent acquires workers for all specs concurrently and
+        reports EACH spec eagerly via a `dispatched` push the moment its
+        acquisition resolves — a fast acquisition never waits for a cold
+        worker spawn sharing its batch. Pushes ride the same ordered
+        connection as the call reply, so every push lands before the reply:
+        the reply (or its failure) is purely the barrier after which
+        still-pending specs are provably unreported and safe to requeue."""
+        conn = self.node_conns.get(nid)
+        if conn is None or conn.closed:
+            for spec, demand in items:
+                self._release(nid, spec, demand)
+                self.pending.append(spec)
+            self._kick()
+            return
+        for spec, demand in items:
+            self._pending_dispatch[spec.task_id] = (spec, demand, nid)
+        try:
+            await conn.call("dispatch_batch", specs=[s for s, _ in items])
+        except Exception:
+            # Transport failure (RpcError, reset, broken pipe): leftovers
+            # are requeued below; a raw OSError must not kill this
+            # fire-and-forget task and leak capacity.
+            pass
+        requeued = False
+        for spec, demand in items:
+            if self._pending_dispatch.pop(spec.task_id, None) is not None:
+                self._release(nid, spec, demand)
+                self.pending.append(spec)
+                requeued = True
+        if requeued:
+            self._kick()
+
+    async def _p_dispatched(self, conn, a):
+        """Per-spec eager dispatch report from an agent (see
+        _dispatch_batch_bg). Exceptions here are isolated per spec — one
+        bad early_done replay must not strand its batch siblings."""
+        ent = self._pending_dispatch.pop(a["task_id"], None)
+        if ent is None:
+            return  # batch barrier already failed this spec over; or dup
+        spec, demand, nid = ent
+        if not a.get("ok"):
             self._release(nid, spec, demand)
             self.pending.append(spec)
             self._kick()
             return
+        try:
+            await self._dispatched(nid, spec, a["worker_id"],
+                                   self.node_conns.get(nid))
+        except Exception:
+            logger.exception("post-dispatch bookkeeping failed for task %s",
+                             a["task_id"][:12])
+
+    async def _dispatched(self, nid: str, spec: TaskSpec, worker_id: str,
+                          nconn) -> None:
+        """Post-dispatch bookkeeping for one successfully placed spec."""
+        self.dispatched[spec.task_id] = {
+            "spec": spec, "node_id": nid, "worker_id": worker_id}
+        if spec.kind == ACTOR_CREATE:
+            ent = self.actors.get(spec.actor_id)
+            if ent is None or ent.state == "DEAD":
+                # kill() raced the creation dispatch: reap the fresh worker
+                # and give the resources back instead of resurrecting. A
+                # task_done that beat the dispatch report is moot now —
+                # drop its parked replay instead of leaving it to the TTL.
+                self.dispatched.pop(spec.task_id, None)
+                self.early_done.pop(spec.task_id, None)
+                self._release(nid, spec, ResourceSet(_raw=spec.resources))
+                try:
+                    await nconn.push("kill_worker", worker_id=worker_id)
+                except Exception:
+                    pass
+                return
+            ent.node_id = nid
+            ent.worker_id = worker_id
+            ent.resources_held = True
         early = self.early_done.pop(spec.task_id, None)
         if early is not None:
             payload = dict(early[0])
@@ -717,7 +801,6 @@ class Controller:
         if spec.task_id in self.cancelled:
             spec.max_retries = 0  # a cancelled task must never retry
             info = self.dispatched.get(spec.task_id)
-            nconn = self.node_conns.get(nid)
             if info is not None and nconn is not None and not nconn.closed:
                 force, _ = self.cancelled.pop(spec.task_id)
                 try:
@@ -749,35 +832,6 @@ class Controller:
         if node is not None:
             node.available.add(demand)
 
-    async def _dispatch(self, nid: str, spec: TaskSpec) -> bool:
-        conn = self.node_conns.get(nid)
-        if conn is None or conn.closed:
-            return False
-        try:
-            rep = await conn.call("dispatch", spec=spec)
-        except Exception:
-            # Any transport failure (RpcError, reset, broken pipe): the
-            # caller releases resources and re-queues; a raw OSError must not
-            # kill the fire-and-forget _dispatch_bg task and leak capacity.
-            return False
-        self.dispatched[spec.task_id] = {"spec": spec, "node_id": nid, "worker_id": rep["worker_id"]}
-        if spec.kind == ACTOR_CREATE:
-            ent = self.actors.get(spec.actor_id)
-            if ent is None or ent.state == "DEAD":
-                # kill() raced the creation dispatch: reap the fresh worker
-                # and give the resources back instead of resurrecting.
-                self.dispatched.pop(spec.task_id, None)
-                self._release(nid, spec, ResourceSet(_raw=spec.resources))
-                try:
-                    await conn.push("kill_worker", worker_id=rep["worker_id"])
-                except Exception:
-                    pass
-                return True
-            ent.node_id = nid
-            ent.worker_id = rep["worker_id"]
-            ent.resources_held = True
-        return True
-
     @staticmethod
     def _ingest_spec(conn, spec: TaskSpec) -> TaskSpec:
         """Over the in-process transport the submitter's LIVE spec arrives;
@@ -801,7 +855,12 @@ class Controller:
         """Push variant: submitters don't need the queue ack (hot path)."""
         await self._h_submit_task(conn, a)
 
-    async def _p_submit_batch(self, conn, a):
+    async def _h_submit_tasks(self, conn, a):
+        """Vectorized submit: a burst of N same-tick submissions rides one
+        frame (reference NormalTaskSubmitter batches raylet RPCs). Callable
+        (the ack tells the submitter the batch is durably queued — with
+        coalesced writes a one-way push could be lost with a dying
+        connection AFTER the submitter's flush succeeded) or push-able."""
         for spec in a["specs"]:
             spec = self._ingest_spec(conn, spec)
             for oid in spec.return_object_ids():
@@ -809,6 +868,11 @@ class Controller:
                 ent.owner = spec.owner_id
             self.pending.append(spec)
         self._kick()
+        return {"queued": True}
+
+    # Push forms (one-way; wire-compat alias for the pre-coalescing name).
+    _p_submit_tasks = _h_submit_tasks
+    _p_submit_batch = _h_submit_tasks
 
     # ------------------------------------------------------ task completion
     async def _p_task_done(self, conn, a):
@@ -828,7 +892,7 @@ class Controller:
         info = self.dispatched.pop(task_id, None)
         if info is None and a.get("spec") is None and not a.get("_replayed"):
             # Completion raced ahead of the dispatch reply: park it for
-            # _dispatch_bg to replay (with a TTL so duplicates can't leak).
+            # _dispatched to replay (with a TTL so duplicates can't leak).
             now = time.monotonic()
             for tid, (_, exp) in list(self.early_done.items()):
                 if exp < now:
@@ -861,7 +925,7 @@ class Controller:
             if ent.state == "ready" and ent.error is None and error is not None:
                 # Late/duplicate error report (e.g. a cancel SIGINT landing
                 # just after completion): the first good value wins.
-                await self._notify_owner(ent, oid)
+                self._notify_owner(ent, oid)
                 continue
             if error is not None:
                 ent.error = error
@@ -871,21 +935,42 @@ class Controller:
             if holder is not None:
                 ent.holders.add(tuple(holder))
             ent.wake()
-            await self._notify_owner(ent, oid)
+            self._notify_owner(ent, oid)
 
-    async def _notify_owner(self, ent: _ObjectEntry, oid: str):
-        owner_conn = self.client_conns.get(ent.owner)
-        if owner_conn is not None and not owner_conn.closed:
+    def _notify_owner(self, ent: _ObjectEntry, oid: str):
+        """Queue an object-ready notification for the owner. Notifications
+        are flushed as ONE `objects_ready` frame per owner per event-loop
+        burst (a batch of task completions costs the owner one frame, not
+        one per oid)."""
+        owner = ent.owner
+        owner_conn = self.client_conns.get(owner)
+        if owner_conn is None or owner_conn.closed:
+            return
+        item = {"oid": oid, "inline": ent.inline,
+                "holders": list(ent.holders), "error": ent.error}
+        buf = self._ready_bufs.get(owner)
+        if buf is not None:
+            buf.append(item)  # a flusher for this owner is already running
+            return
+        self._ready_bufs[owner] = [item]
+        asyncio.ensure_future(self._a_flush_ready(owner))
+
+    async def _a_flush_ready(self, owner: str):
+        while True:
+            items = self._ready_bufs.get(owner)
+            if not items:
+                self._ready_bufs.pop(owner, None)
+                return
+            self._ready_bufs[owner] = []
+            conn = self.client_conns.get(owner)
+            if conn is None or conn.closed:
+                self._ready_bufs.pop(owner, None)
+                return
             try:
-                await owner_conn.push(
-                    "object_ready",
-                    oid=oid,
-                    inline=ent.inline,
-                    holders=list(ent.holders),
-                    error=ent.error,
-                )
+                await conn.push("objects_ready", items=items)
             except Exception:
-                pass
+                self._ready_bufs.pop(owner, None)
+                return
 
     async def _p_task_failed(self, conn, a):
         """Worker/system failure (not a user exception): retry or fail."""
@@ -928,7 +1013,7 @@ class Controller:
             ent.state = "ready"
             ent.error = final_error
             ent.wake()
-            await self._notify_owner(ent, oid)
+            self._notify_owner(ent, oid)
 
     async def _finish_cancelled(self, spec: TaskSpec):
         from ray_tpu._private.serialization import dumps_oob
@@ -941,7 +1026,7 @@ class Controller:
             ent.state = "ready"
             ent.error = [h, *b]
             ent.wake()
-            await self._notify_owner(ent, oid)
+            self._notify_owner(ent, oid)
 
     async def _h_cancel_task(self, conn, a):
         """Cancel a queued or running task (reference core_worker.proto:492

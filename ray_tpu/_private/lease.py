@@ -126,21 +126,34 @@ class LeaseManager:
     def _pump(self, cls: _Class):
         # Assign queued specs to the least-loaded live leases (skip leases
         # whose worker is being force-kill-cancelled: it is already doomed).
+        # Specs are handed out in per-lease batches (ONE lock acquisition +
+        # ONE flush kick per round): a burst of N submissions costs
+        # O(leases) lock/min() rounds, not O(N). Each round takes at most
+        # ceil(queue/live) specs so a burst smaller than depth*leases still
+        # SPREADS across the live leases instead of convoying on one.
         live = [l for l in cls.leases.values()
                 if not l.dead and l.kill_target is None]
         while cls.queue and live:
             lease = min(live, key=lambda l: len(l.inflight))
-            if len(lease.inflight) >= cls.depth:
+            room = cls.depth - len(lease.inflight)
+            if room <= 0:
                 break
+            batch = []
             with self._lock:
-                if not cls.queue:
-                    break
-                spec = cls.queue.popleft()
-            if self._consume_cancel_queued(spec):
-                continue
-            lease.inflight[spec.task_id] = spec
-            lease.buf.append(spec)
-            if not lease.flushing:
+                qlen = len(cls.queue)
+                take = min(room, -(-qlen // len(live)))
+                for _ in range(min(take, qlen)):
+                    batch.append(cls.queue.popleft())
+            if not batch:
+                break
+            assigned = False
+            for spec in batch:
+                if self._consume_cancel_queued(spec):
+                    continue
+                lease.inflight[spec.task_id] = spec
+                lease.buf.append(spec)
+                assigned = True
+            if assigned and not lease.flushing:
                 lease.flushing = True
                 asyncio.ensure_future(self._a_flush(lease))
         if cls.queue and not cls.requesting:

@@ -219,6 +219,29 @@ class NodeAgent:
     async def _on_ctrl_request(self, conn, method, a):
         if method == "dispatch":
             return await self._dispatch(a["spec"])
+        if method == "dispatch_batch":
+            # One frame per scheduling pass per node; worker acquisition
+            # fans out concurrently and each spec is reported EAGERLY via a
+            # `dispatched` push the moment its acquisition resolves (frames
+            # coalesce on the wire) — a warm pool hit must not wait for a
+            # cold spawn sharing its batch. The call reply is the barrier:
+            # it follows every push on this ordered connection.
+            async def _one(spec):
+                try:
+                    rep = await self._dispatch(spec)
+                    out = {"task_id": spec.task_id, "ok": True,
+                           "worker_id": rep["worker_id"]}
+                except Exception as e:
+                    out = {"task_id": spec.task_id, "ok": False,
+                           "error": repr(e)}
+                try:
+                    await conn.push("dispatched", **out)
+                except Exception:
+                    pass  # conn died: the controller's barrier requeues
+                return out
+
+            results = await asyncio.gather(*[_one(s) for s in a["specs"]])
+            return {"results": list(results)}
         if method == "lease_worker":
             slot = await self._acquire_pool_worker()
             if conn.closed:

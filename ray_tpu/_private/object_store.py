@@ -38,9 +38,10 @@ class _StreamWriter:
         self._done = False
 
     def write(self, offset: int, data) -> None:
-        if not isinstance(data, (bytes, bytearray)):
-            data = memoryview(data).cast("B")
-        self._mm[offset : offset + len(data)] = data
+        # Same copy machinery as put(): multi-MB fetch chunks use the
+        # native threaded memcpy when available (the fetch pipeline calls
+        # this off the event loop, overlapping the copy with socket recv).
+        LocalStore._copy_in(self._mm, offset, data)
 
     def seal(self) -> bool:
         self._done = True

@@ -13,6 +13,7 @@ Frame layout (everything little-endian):
 from __future__ import annotations
 
 import asyncio
+import socket
 import struct
 import threading
 import traceback
@@ -22,6 +23,27 @@ from typing import Awaitable, Callable, Optional
 from ray_tpu._private.serialization import dumps_oob, loads_oob
 
 _HDR = struct.Struct("<Q")
+
+
+# Write-coalescing knobs live in the rtconfig registry like every other
+# runtime flag (env RT_RPC_COALESCE / RT_RPC_WBUF_HIGH_BYTES /
+# RT_RPC_JOIN_BYTES, or init(_system_config={...}) — the resolved table is
+# propagated cluster-wide at registration). Connections cache the values at
+# construction; see the README "Transport" section.
+from ray_tpu._private.rtconfig import CONFIG as _CONFIG  # noqa: E402
+
+
+def _set_nodelay(writer) -> None:
+    """Assert TCP_NODELAY on TCP sockets. asyncio sets it by default on TCP
+    transports, but the coalesced write path depends on it (a batched burst
+    must not sit in the Nagle window), so assert it explicitly."""
+    try:
+        sock = writer.get_extra_info("socket")
+        if sock is not None and sock.family in (socket.AF_INET,
+                                                socket.AF_INET6):
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    except Exception:
+        pass
 
 
 # ------------------------------------------------------- fault injection
@@ -263,6 +285,18 @@ class Connection:
         self._next_id = 0
         self._pending: dict[int, asyncio.Future] = {}
         self._wlock = asyncio.Lock()
+        # Adaptive frame coalescing (reference: gRPC's writev-style batched
+        # stream writes): _write appends encoded frames to _wbuf; ONE
+        # flusher per burst writes everything buffered and drains once.
+        # Strict per-connection FIFO is preserved (appends happen in _write
+        # call order, the single flusher writes in append order).
+        self._coalesce = _CONFIG.rpc_coalesce
+        self._whigh = _CONFIG.rpc_wbuf_high_bytes
+        self._wjoin = _CONFIG.rpc_join_bytes
+        self._wbuf: list = []  # bytes/memoryview parts + float delay markers
+        self._wbuf_bytes = 0
+        self._wflushing = False
+        self._wdrain_evt: Optional[asyncio.Event] = None
         self.on_request: Optional[Callable[["Connection", str, dict], Awaitable]] = None
         self.on_push: Optional[Callable[["Connection", str, dict], Awaitable]] = None
         self.on_close: Optional[Callable[["Connection"], None]] = None
@@ -286,6 +320,11 @@ class Connection:
             return None
 
     async def _write(self, msg: dict):
+        # Fault injection applies to the LOGICAL frame here, before any
+        # coalescing: drop removes exactly this frame from the stream, dup
+        # enqueues it twice, delay inserts a hold-the-line marker, sever
+        # kills the connection (frames already buffered may be lost with it,
+        # like a TCP reset).
         repeat, delay = 1, 0.0
         if _INJECTOR is not None:
             rule = _INJECTOR.pick(self, "send", msg)
@@ -303,16 +342,107 @@ class Connection:
                         pass
                     raise ConnectionClosed("fault injection: connection severed")
         parts = _encode(msg)
-        async with self._wlock:
-            if delay:
-                # Sleep INSIDE the write lock: a delayed frame must hold up
-                # younger frames like a slow link would — per-connection
-                # reordering is a fault TCP cannot produce.
-                await asyncio.sleep(delay)
-            for _ in range(repeat):
-                for p in parts:
-                    self.writer.write(p)
-            await self.writer.drain()
+        if not self._coalesce:
+            # Legacy path (RT_RPC_COALESCE=0): one drain per frame.
+            async with self._wlock:
+                if delay:
+                    # Sleep INSIDE the write lock: a delayed frame must hold
+                    # up younger frames like a slow link would —
+                    # per-connection reordering is a fault TCP cannot
+                    # produce.
+                    await asyncio.sleep(delay)
+                for _ in range(repeat):
+                    for p in parts:
+                        self.writer.write(p)
+                await self.writer.drain()
+            return
+        if self.closed:
+            raise ConnectionClosed("connection closed")
+        if delay:
+            # float() pins the flusher's delay-marker type check even when
+            # a rule was built with an int delay_s.
+            self._wbuf.append(float(delay))
+        n = 0
+        for p in parts:
+            n += len(p)
+        for _ in range(repeat):
+            self._wbuf.extend(parts)
+        self._wbuf_bytes += n * repeat
+        if not self._wflushing:
+            self._wflushing = True
+            asyncio.ensure_future(self._a_wflush())
+        if self._wbuf_bytes >= self._whigh:
+            # Backpressure: park until the flusher catches up (the legacy
+            # path got the same bound from its per-frame drain).
+            while self._wbuf_bytes >= self._whigh and not self.closed:
+                if self._wdrain_evt is None:
+                    self._wdrain_evt = asyncio.Event()
+                self._wdrain_evt.clear()
+                await self._wdrain_evt.wait()
+
+    async def _a_wflush(self):
+        """Single writer per burst: drains whatever accumulated while the
+        previous socket write was in flight — frames buffered by N
+        concurrent _write()s ride one write+drain."""
+        w = self.writer
+        try:
+            while True:
+                buf = self._wbuf
+                if not buf:
+                    self._wflushing = False
+                    return
+                self._wbuf = []
+                self._wbuf_bytes = 0
+                if self._wdrain_evt is not None:
+                    self._wdrain_evt.set()
+                small: list = []
+                small_n = 0
+                for item in buf:
+                    if type(item) is float:
+                        # Injected delay marker: flush everything older,
+                        # then hold the line — younger frames wait behind
+                        # the delayed one like on a slow link.
+                        if small:
+                            w.write(small[0] if len(small) == 1
+                                    else b"".join(small))
+                            small, small_n = [], 0
+                        await w.drain()
+                        await asyncio.sleep(item)
+                        continue
+                    if len(item) <= self._wjoin:
+                        small.append(item)
+                        small_n += len(item)
+                        if small_n >= self._whigh:
+                            w.write(b"".join(small))
+                            small, small_n = [], 0
+                    else:
+                        # Large part (zero-copy tensor buffer): write
+                        # uncopied, flanked by the joined small parts.
+                        if small:
+                            w.write(small[0] if len(small) == 1
+                                    else b"".join(small))
+                            small, small_n = [], 0
+                        w.write(item)
+                if small:
+                    w.write(small[0] if len(small) == 1 else b"".join(small))
+                await w.drain()
+        except (ConnectionResetError, BrokenPipeError, ConnectionClosed,
+                OSError, asyncio.CancelledError):
+            pass
+        except Exception:
+            traceback.print_exc()
+        # Write side died under buffered frames: surface via the normal
+        # close path and wake writers parked on backpressure.
+        self.closed = True
+        self._wflushing = False
+        self._wbuf.clear()
+        self._wbuf_bytes = 0
+        if self._wdrain_evt is not None:
+            self._wdrain_evt.set()
+        try:
+            w.close()
+        except Exception:
+            pass
 
     async def call(self, method: str, _timeout: float | None = None, **payload):
         # Fail fast on a dead connection: the read loop already rejected
@@ -341,8 +471,10 @@ class Connection:
     async def call_start(self, method: str, **payload) -> asyncio.Future:
         """Write a request and return the reply future WITHOUT awaiting it.
 
-        Lets a caller serialize request *ordering* (the write happens before
-        this returns) while overlapping many in-flight replies — the mechanism
+        Lets a caller serialize request *ordering* (the frame is queued on
+        the connection's FIFO write buffer before this returns, and the
+        single flusher writes strictly in queue order) while overlapping
+        many in-flight replies — the mechanism
         behind ordered-but-pipelined actor calls (reference: sequence numbers
         in core_worker/transport/sequential_actor_submit_queue.h).
         The caller must consume the future (and pop it from _pending on error).
@@ -436,6 +568,8 @@ class Connection:
                 if not fut.done():
                     fut.set_exception(ConnectionClosed("peer went away"))
             self._pending.clear()
+            if self._wdrain_evt is not None:
+                self._wdrain_evt.set()  # unblock writers parked on backpressure
             try:
                 self.writer.close()
             except Exception:
@@ -449,12 +583,30 @@ class Connection:
     async def close(self):
         if self._read_task is not None:
             self._read_task.cancel()
+        # Graceful close drains frames _write already accepted: with
+        # coalescing, push() returns once the frame is buffered, so a
+        # push-then-close sequence (e.g. a worker's final task_done before
+        # disconnect) must not drop the buffered frame. Bounded wait — a
+        # dead peer can't hold the close hostage.
+        if (self._wbuf or self._wflushing) and not self.closed:
+            try:
+                await asyncio.wait_for(self._a_wait_flushed(), 2.0)
+            except Exception:
+                pass
+        self.closed = True
+        self._wbuf.clear()
+        self._wbuf_bytes = 0
+        if self._wdrain_evt is not None:
+            self._wdrain_evt.set()
         try:
             self.writer.close()
             await self.writer.wait_closed()
         except Exception:
             pass
-        self.closed = True
+
+    async def _a_wait_flushed(self):
+        while (self._wbuf or self._wflushing) and not self.closed:
+            await asyncio.sleep(0.005)
 
 
 def _uds_dir() -> Optional[str]:
@@ -555,6 +707,7 @@ class RpcServer:
         return self.port
 
     async def _accept(self, reader, writer):
+        _set_nodelay(writer)
         conn = Connection(reader, writer)
         conn.on_request = self._on_request
         conn.on_push = self._on_push
@@ -772,6 +925,7 @@ async def connect(
                 reader = writer = None  # fall back to TCP
     if reader is None:
         reader, writer = await asyncio.wait_for(asyncio.open_connection(host, port), timeout)
+        _set_nodelay(writer)
     conn = Connection(reader, writer)
     conn.label = label
     conn.on_request = on_request

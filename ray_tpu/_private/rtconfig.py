@@ -87,6 +87,14 @@ _flag("max_pending_calls_default", int, -1)
 # _generator_backpressure_num_objects); <=0 disables backpressure.
 _flag("generator_backpressure_items", int, 64)
 _flag("log_to_driver", bool, True)
+# RPC write coalescing (see README "Transport"): frames buffer per
+# connection and flush with ONE drain per event-loop burst. rpc_coalesce
+# False restores the legacy one-drain-per-frame path; wbuf_high_bytes is
+# the writer-backpressure high-water mark; parts up to join_bytes are
+# joined into one transport write (larger oob buffers go zero-copy).
+_flag("rpc_coalesce", bool, True)
+_flag("rpc_wbuf_high_bytes", int, 4 << 20)
+_flag("rpc_join_bytes", int, 128 << 10)
 # Fixed-point resource arithmetic granularity (reference fixed_point.h uses 1e-4).
 _flag("resource_unit", int, 10000)
 

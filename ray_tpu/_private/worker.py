@@ -22,6 +22,7 @@ import threading
 import time
 import traceback
 from collections import deque
+from concurrent.futures import TimeoutError as _FuturesTimeout
 from typing import Any, Optional
 
 from ray_tpu import exceptions as exc
@@ -638,10 +639,13 @@ class Worker:
             self.lease_mgr.on_lease_invalid(a["lease_id"], cause=a.get("cause"))
         elif method == "need_resources":
             self.lease_mgr.on_need_resources()
-        elif method == "object_ready":
-            self._ctrl_resolved.add(a["oid"])
-            res = self._resolutions.setdefault(a["oid"], _Resolution())
-            res.resolve(a.get("inline"), [tuple(h) for h in a.get("holders", [])], a.get("error"))
+        elif method == "objects_ready":
+            # Batched completion notifications: one frame resolves a whole
+            # burst of owned oids.
+            for item in a["items"]:
+                self._apply_object_ready(item)
+        elif method == "object_ready":  # single-oid form (compat)
+            self._apply_object_ready(a)
         elif method == "worker_log":
             # Streamed worker stdout/stderr (reference log_monitor ->
             # driver printer, "(pid=...) ..." prefixes).
@@ -660,6 +664,12 @@ class Worker:
                                      "message": f"object {oid[:16]} lost (node died)"})
                 res = self._resolutions.setdefault(oid, _Resolution())
                 res.resolve(None, [], [h, *bufs])
+
+    def _apply_object_ready(self, a: dict):
+        self._ctrl_resolved.add(a["oid"])
+        res = self._resolutions.setdefault(a["oid"], _Resolution())
+        res.resolve(a.get("inline"),
+                    [tuple(h) for h in a.get("holders", [])], a.get("error"))
 
     # ----------------------------------------------------------- refcounts
     def _incref(self, oid: str):
@@ -964,45 +974,71 @@ class Worker:
             self._pull_cv.notify_all()
 
     def _fetch_from(self, holder: tuple, oid: str, deadline) -> bool:
-        """Fetch an object into the local store in bounded chunks. Returns
-        True once a local copy exists (including 'someone else fetched it
+        """Fetch an object into the local store in bounded chunks, with the
+        NEXT chunk's request already in flight while the current chunk is
+        copied into the stream segment — socket recv overlaps the memcpy
+        (double buffering through LocalStore.begin_stream). Returns True
+        once a local copy exists (including 'someone else fetched it
         first')."""
         chunk = CONFIG.object_chunk_bytes
-
-        async def _fetch_chunk(conn, off):
-            return await conn.call("fetch_object", oid=oid, offset=off,
-                                   length=chunk)
-
-        def _run(coro):
-            return self.io.run(coro, timeout=self._remaining(deadline))
-
-        conn = _run(rpc.connect(*holder, timeout=5))
-        stream = None
-        self._acquire_pull(chunk)
-        held = chunk
+        held = 2 * chunk  # double buffering holds up to two chunks in flight
+        self._acquire_pull(held)
         try:
-            rep = _run(_fetch_chunk(conn, 0))
+            rem = self._remaining(deadline)
+            return self.io.run(
+                self._a_fetch_from(holder, oid, chunk, rem),
+                timeout=None if rem is None else rem + 5)
+        except (asyncio.TimeoutError, _FuturesTimeout):
+            raise exc.GetTimeoutError(f"fetch of {oid[:16]} timed out")
+        finally:
+            self._release_pull(held)
+
+    async def _a_fetch_from(self, holder: tuple, oid: str, chunk: int,
+                            timeout: float | None) -> bool:
+        if timeout is not None:
+            return await asyncio.wait_for(
+                self._a_fetch_pipeline(holder, oid, chunk), timeout)
+        return await self._a_fetch_pipeline(holder, oid, chunk)
+
+    async def _a_fetch_pipeline(self, holder: tuple, oid: str,
+                                chunk: int) -> bool:
+        conn = await rpc.connect(*holder, timeout=5)
+        stream = None
+        nxt = None
+        try:
+            rep = await conn.call("fetch_object", oid=oid, offset=0,
+                                  length=chunk)
             if not rep.get("found"):
                 return False
             size = rep["size"]
-            first = rep["data"]
-            if size <= len(first):
-                self.store.put(oid, [first])
+            data = rep["data"]
+            if size <= len(data):
+                self.store.put(oid, [data])
                 return True
             stream = self.store.begin_stream(oid, size)
             if stream is None:
                 return True  # raced: a local copy already exists
-            stream.write(0, first)
-            off = len(first)
-            del rep, first  # release the buffer before the next admission
-            while off < size:
-                rep = _run(_fetch_chunk(conn, off))
+            off = len(data)
+            woff = 0
+            while True:
+                # Pipeline: request chunk k+1 BEFORE copying chunk k, and
+                # do the copy in a worker thread so the event loop keeps
+                # receiving the next chunk during the memcpy.
+                nxt = (await conn.call_start("fetch_object", oid=oid,
+                                             offset=off, length=chunk)
+                       if off < size else None)
+                await asyncio.to_thread(stream.write, woff, data)
+                del data
+                if nxt is None:
+                    break
+                rep = await nxt
+                nxt = None
                 if not rep.get("found"):
                     return False  # holder dropped it mid-stream
                 data = rep["data"]
-                stream.write(off, data)
+                woff = off
                 off += len(data)
-                del rep, data
+                del rep
             sealed = stream.seal()
             stream = None
             # seal() returning False means a concurrent fetch won the race
@@ -1011,10 +1047,15 @@ class Worker:
             # object is actually there.
             return sealed or self.store.contains(oid)
         finally:
-            self._release_pull(held)
+            if nxt is not None:
+                # Cancellation/copy failure left the one-ahead request
+                # un-awaited: consume its eventual error (call_start's
+                # contract) so the loop never logs an unretrieved exception.
+                nxt.add_done_callback(
+                    lambda f: f.cancelled() or f.exception())
             if stream is not None:
                 stream.abort()
-            self.io.spawn(conn.close())
+            asyncio.ensure_future(conn.close())
 
     def _maybe_reconstruct(self, oid: str) -> bool:
         """Lineage reconstruction: resubmit the producing task (reference
@@ -1540,7 +1581,12 @@ class Worker:
                     self._submit_flushing = False
                     return
             try:
-                await self.controller.push("submit_batch", specs=batch)
+                # Acked call, not a push: with coalesced writes a push
+                # "succeeds" once buffered, so a connection dying before
+                # the flush would silently strand the batch's refs forever.
+                # One round-trip per BATCH keeps the ack off the per-task
+                # cost.
+                await self.controller.call("submit_tasks", specs=batch)
             except Exception as e:
                 # The push failed after the specs left the buffer: fail the
                 # batch's refs so callers see an error instead of a hang —

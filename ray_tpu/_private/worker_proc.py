@@ -116,6 +116,8 @@ class WorkerProc:
         self._event_win_start = 0.0
         self._event_win_count = 0
         self._advertise_pusher: _BatchPusher | None = None
+        self._pid = os.getpid()  # cached: one event record per task must
+        # not pay a getpid syscall (worker procs never fork-and-continue)
         self._running = True
 
     # ------------------------------------------------------------ startup
@@ -502,7 +504,7 @@ class WorkerProc:
                 "kind": spec.kind, "attempt": spec.attempt,
                 "start": start, "end": end, "ok": ok,
                 "worker_id": self.worker_id, "node_id": self.node_id,
-                "pid": os.getpid(),
+                "pid": self._pid,
             })
         except Exception:
             pass  # observability must never break execution

@@ -15,7 +15,9 @@ import jax
 import jax.numpy as jnp
 
 logger = logging.getLogger(__name__)
-_warned_fallback = False
+# Warn once PER DISTINCT REASON (not once per process): a second, different
+# shape rejection must not be silently swallowed by the first one's flag.
+_warned_reasons: set[str] = set()
 
 
 def dot_product_attention(q, k, v, *, causal: bool = True, use_pallas: bool | None = None):
@@ -26,7 +28,6 @@ def dot_product_attention(q, k, v, *, causal: bool = True, use_pallas: bool | No
     kernel on TPU; every fallback is LOGGED, never silent. The kernel's own
     ValueError is the single source of truth for shape support (no
     duplicated predicate to drift)."""
-    global _warned_fallback
     if use_pallas is None:
         use_pallas = jax.default_backend() == "tpu"
     if use_pallas:
@@ -35,11 +36,12 @@ def dot_product_attention(q, k, v, *, causal: bool = True, use_pallas: bool | No
         try:
             return flash_attention(q, k, v, causal=causal)
         except ValueError as e:
-            if not _warned_fallback:
-                _warned_fallback = True
+            reason = str(e)
+            if reason not in _warned_reasons:
+                _warned_reasons.add(reason)
                 logger.warning(
                     "attention falling back to the XLA path (%s); "
-                    "O(Sq*Sk) memory", e)
+                    "O(Sq*Sk) memory", reason)
         except Exception as e:
             # Mosaic lowering limits, odd head dims, dtypes: loud safety net.
             logger.warning("flash attention kernel failed (%r); "

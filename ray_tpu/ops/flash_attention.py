@@ -29,6 +29,22 @@ DEFAULT_BLOCK_K = 1024
 NEG_INF = float("-inf")
 
 
+def _auto_block(dim: int, preferred: int, align: int) -> int | None:
+    """Largest divisor of `dim` that is a multiple of `align` (TPU sublane/
+    lane tiling) and <= `preferred`. None when no aligned divisor exists
+    (the shape then falls back to the XLA path). Auto-deriving from the
+    input shape keeps the tuned defaults for big sequences while accepting
+    any lane-alignable Sq/Sk — e.g. Sq=Sk=640 picks 320/640, not a
+    hard-coded 512/1024 that 640 doesn't divide."""
+    if dim % align:
+        return None
+    best = None
+    for cand in range(align, min(preferred, dim) + 1, align):
+        if dim % cand == 0:
+            best = cand
+    return best
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
                   scale: float, causal: bool, block_q: int, block_k: int,
                   n_k_blocks: int, diag_offset: int):
@@ -89,16 +105,32 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
     jax.jit,
     static_argnames=("causal", "block_q", "block_k", "interpret"))
 def flash_attention(q, k, v, *, causal: bool = True,
-                    block_q: int = DEFAULT_BLOCK_Q,
-                    block_k: int = DEFAULT_BLOCK_K,
+                    block_q: int | None = None,
+                    block_k: int | None = None,
                     interpret: bool = False):
     """q: [B, Sq, Hq, D]; k/v: [B, Sk, Hkv, D] (GQA when Hq > Hkv).
-    Returns [B, Sq, Hq, D]. Raises ValueError for unsupported shapes (the
-    dispatcher falls back to the XLA path and logs)."""
+    Returns [B, Sq, Hq, D]. block_q/block_k default to lane-aligned sizes
+    auto-derived from Sq/Sk (largest aligned divisors up to the tuned
+    512/1024). Raises ValueError for unsupported shapes (the dispatcher
+    falls back to the XLA path and logs)."""
     b, sq, hq, d = q.shape
     _, sk, hkv, _ = k.shape
     if hq % hkv != 0:
         raise ValueError(f"Hq={hq} not a multiple of Hkv={hkv}")
+    if block_q is None:
+        block_q = _auto_block(sq, DEFAULT_BLOCK_Q, 8)
+        if block_q is None:
+            raise ValueError(
+                f"Sq={sq} has no divisor aligned to the TPU sublane "
+                f"tile (8)")
+    if block_k is None:
+        # block_k spans the LANE axis of the [block_q, block_k] score
+        # tile, so it needs 128-alignment (block_q only needs sublane 8).
+        block_k = _auto_block(sk, DEFAULT_BLOCK_K, 128)
+        if block_k is None:
+            raise ValueError(
+                f"Sk={sk} has no divisor aligned to the TPU lane tile "
+                f"(128)")
     block_q = min(block_q, sq)
     block_k = min(block_k, sk)
     if sq % block_q or sk % block_k:

@@ -22,6 +22,19 @@ import pytest  # noqa: E402
 import ray_tpu  # noqa: E402
 
 
+def pytest_collection_modifyitems(config, items):
+    """`perf`-marked tests (bench smoke) run only on request (RT_RUN_PERF=1):
+    they time things, so they are useless under tier-1's parallel load and
+    would eat its time budget."""
+    if os.environ.get("RT_RUN_PERF"):
+        return
+    skip = pytest.mark.skip(
+        reason="perf smoke; set RT_RUN_PERF=1 to run (not part of tier-1)")
+    for item in items:
+        if "perf" in item.keywords:
+            item.add_marker(skip)
+
+
 @pytest.fixture
 def shutdown_only():
     yield None

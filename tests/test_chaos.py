@@ -283,6 +283,148 @@ def test_conn_blip_longer_than_grace_runs_death_path(chaos_cleanup):
     assert ray_tpu.get(a.bump.remote(), timeout=30) == 1
 
 
+# --------------------------------------------------------------------------
+# Transport-level: coalesced writes must keep FaultInjector PER-LOGICAL-FRAME
+# semantics (drop/delay/dup/sever apply to individual frames, not to the
+# coalesced byte blob) and preserve strict per-connection ordering.
+
+
+class _XportHarness:
+    """Raw RpcServer + client Connection over a real socket (the in-process
+    LocalConnection bypass is disabled so frames actually ride the
+    coalescing write buffer)."""
+
+    def __init__(self, label="xport"):
+        self.got: list = []   # push payloads in arrival order
+        self.reqs: list = []  # request payloads in arrival order
+        self.io = rpc.EventLoopThread(name="xport-srv")
+        self.cio = rpc.EventLoopThread(name="xport-cli")
+
+        async def on_req(conn, method, a):
+            self.reqs.append(a["i"])
+            return a["i"]
+
+        async def on_push(conn, method, a):
+            self.got.append(a["i"])
+
+        self.server = rpc.RpcServer(on_req, on_push)
+        port = self.io.run(self.server.start("127.0.0.1", 0))
+        rpc._LOCAL_SERVERS.pop(port, None)  # force the socket path
+        self.conn = self.cio.run(rpc.connect("127.0.0.1", port, label=label))
+
+    def burst(self, n, method="p"):
+        async def _go():
+            errors = []
+            for i in range(n):
+                try:
+                    await self.conn.push(method, i=i)
+                except rpc.ConnectionClosed:
+                    errors.append(i)
+            return errors
+
+        return self.cio.run(_go(), timeout=30)
+
+    def close(self):
+        for fn in (lambda: self.cio.run(self.conn.close(), timeout=5),
+                   lambda: self.io.run(self.server.stop(), timeout=5)):
+            try:
+                fn()
+            except Exception:
+                pass
+        self.cio.stop()
+        self.io.stop()
+
+
+@pytest.fixture
+def xport_injector():
+    inj = rpc.enable_fault_injection()
+    inj.clear()
+    yield inj
+    inj.clear()
+    rpc.disable_fault_injection()
+
+
+def test_coalesced_burst_drop_exactly_one_frame(xport_injector):
+    """A drop rule must remove exactly ONE logical frame from a burst that
+    rides a coalesced write — not the whole coalesced blob."""
+    h = _XportHarness()
+    try:
+        rule = xport_injector.add_rule(
+            "xport", "drop", direction="send", methods={"p"},
+            after=3, times=1)
+        assert h.burst(10) == []
+        _wait(lambda: len(h.got) >= 9, 15, "burst delivery")
+        time.sleep(0.2)  # no straggler may follow
+        assert h.got == [0, 1, 2, 4, 5, 6, 7, 8, 9]
+        assert rule.applied == 1
+    finally:
+        h.close()
+
+
+def test_coalesced_burst_sever_mid_burst_stops_later_frames(xport_injector):
+    """Sever landing on frame k of a coalesced burst kills the connection:
+    frames after k are NEVER delivered (earlier frames may be lost with the
+    reset too, but whatever arrives is an in-order prefix), and the sender
+    observes ConnectionClosed from the severed frame on."""
+    h = _XportHarness()
+    try:
+        xport_injector.add_rule(
+            "xport", "sever", direction="send", methods={"p"}, after=5)
+        errors = h.burst(10)
+        assert errors and min(errors) == 5, errors
+        time.sleep(0.3)
+        assert all(i < 5 for i in h.got), f"post-sever frame delivered: {h.got}"
+        assert h.got == sorted(h.got)
+        _wait(lambda: h.conn.closed, 10, "client side to observe the close")
+    finally:
+        h.close()
+
+
+def test_coalesced_burst_dup_and_delay_per_frame(xport_injector):
+    """dup duplicates exactly one logical frame in place; a delayed frame
+    holds up YOUNGER frames (per-connection ordering survives — TCP cannot
+    reorder, so neither may the injector under coalescing)."""
+    h = _XportHarness()
+    try:
+        rule = xport_injector.add_rule(
+            "xport", "dup", direction="send", methods={"p"},
+            after=2, times=1)
+        assert h.burst(6) == []
+        _wait(lambda: len(h.got) >= 7, 15, "dup burst delivery")
+        assert h.got == [0, 1, 2, 2, 3, 4, 5]
+        assert rule.applied == 1
+
+        xport_injector.clear()
+        h.got.clear()
+        rule = xport_injector.add_rule(
+            "xport", "delay", direction="send", methods={"p"},
+            after=2, times=1, delay_s=0.25)
+        assert h.burst(6) == []
+        _wait(lambda: len(h.got) >= 6, 15, "delayed burst delivery")
+        assert h.got == [0, 1, 2, 3, 4, 5], "delay reordered the burst"
+        assert rule.applied == 1
+    finally:
+        h.close()
+
+
+def test_call_start_pipelined_ordering_survives_coalescing(xport_injector):
+    """call_start's contract — requests hit the peer in issue order while
+    replies overlap — must hold when the frames ride one coalesced write."""
+    h = _XportHarness()
+    try:
+        async def pipeline():
+            import asyncio
+
+            futs = [await h.conn.call_start("m", i=i) for i in range(50)]
+            return await asyncio.gather(*futs)
+
+        res = h.cio.run(pipeline(), timeout=30)
+        assert list(res) == list(range(50))
+        assert h.reqs == list(range(50)), "requests arrived out of order"
+    finally:
+        h.close()
+
+
 def test_stale_incarnation_message_rejected(chaos_cleanup):
     """A zombie agent from a previous life of a node pushes heartbeats and
     worker_died with its old incarnation: the controller rejects and logs

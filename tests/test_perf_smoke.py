@@ -1,0 +1,29 @@
+"""CI perf smoke: `python bench.py --smoke` must complete sub-30s-per-
+section and emit its one-line JSON report. Marked `perf` — never runs in
+the tier-1 budget; enable with RT_RUN_PERF=1 (e.g. a dedicated perf CI
+lane)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.perf
+
+
+def test_bench_smoke_runs():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(root, "bench.py"), "--smoke"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=root)
+    assert out.returncode == 0, out.stderr[-2000:]
+    rep = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rep["metric"] == "microbench_geomean"
+    assert rep["details"].get("smoke") is True
+    # The hot-path metrics this PR targets must be present and nonzero.
+    for k in ("multi_client_tasks_async", "n_n_actor_calls_async",
+              "single_client_put_gigabytes"):
+        assert rep["details"][k] > 0
