@@ -226,6 +226,12 @@ def main(smoke: bool = False):
 
     ray_tpu.shutdown()
 
+    if smoke:
+        # Direct-dispatch A/B (perf-gate input, tests/test_perf_smoke.py):
+        # the SAME multi-client workload with RT_DIRECT_DISPATCH=0 routes
+        # every task through the controller — direct dispatch must beat it.
+        _bench_ctrl_path_multi_client(extra_details)
+
     ratios = {k: results[k] / BASELINES[k] for k in BASELINES if k in results}
     # put-GB/s is bounded by this host's memcpy bandwidth (one mandatory
     # copy into shm); the 19.4 GB/s baseline box had ~4x this box's memory
@@ -255,6 +261,49 @@ def main(smoke: bool = False):
         "vs_baseline": round(geomean, 4),
         "details": details,
     }), flush=True)
+
+
+def _bench_ctrl_path_multi_client(details: dict):
+    """Controller-path comparison run for the multi-client workload
+    (smoke only): a fresh cluster with RT_DIRECT_DISPATCH=0, so every
+    plain task rides the classic controller dispatch. Reported as
+    `multi_client_tasks_async_controller_path` (details only — not a
+    ratio metric; it exists to prove direct dispatch earns its keep)."""
+    import ray_tpu
+
+    prev = os.environ.get("RT_DIRECT_DISPATCH")
+    os.environ["RT_DIRECT_DISPATCH"] = "0"
+    try:
+        ray_tpu.init(num_cpus=4)
+
+        @ray_tpu.remote
+        def noop():
+            return None
+
+        @ray_tpu.remote(num_cpus=0)
+        class TaskClient:
+            def run(self, n):
+                ray_tpu.get([noop.remote() for _ in range(n)], timeout=120)
+                return n
+
+        clients = [TaskClient.remote() for _ in range(4)]
+        ray_tpu.get([c.run.remote(10) for c in clients], timeout=120)
+        details["multi_client_tasks_async_controller_path"] = round(timeit(
+            "multi client tasks async (controller path)",
+            lambda: ray_tpu.get([c.run.remote(100) for c in clients],
+                                timeout=120),
+            multiplier=400), 1)
+    except Exception as e:
+        log(f"  controller-path comparison skipped: {e}")
+    finally:
+        if prev is None:
+            os.environ.pop("RT_DIRECT_DISPATCH", None)
+        else:
+            os.environ["RT_DIRECT_DISPATCH"] = prev
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
 
 
 # ---- compiled-graph channel round-trip (native futex ring) ---------------

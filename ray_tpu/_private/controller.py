@@ -146,6 +146,22 @@ class Controller:
         self.leases: dict[str, dict] = {}
         self._last_need_push = 0.0
         self._lease_waiters = 0  # parked lease requests (fair-share signal)
+        # Parked lease requests waiting for capacity: woken the moment a
+        # lease returns / resources free instead of polling on a timer
+        # (the 20ms poll sat directly on multi-client handoff latency).
+        self._lease_waiter_futs: list[asyncio.Future] = []
+        # node_id -> warm returned leases: a returned lease's worker slot
+        # stays 'leased' at the agent for lease_idle_s, so a matching
+        # regrant (the multi-client handoff hot path) is pure controller
+        # bookkeeping — no agent round trip, and usually a cached owner
+        # connection. Entries: {worker_id, address, demand, expires}.
+        self.lease_pool: dict[str, list] = {}
+        self._lease_pool_size = 0
+        # Observability for the direct-dispatch plane (asserted by tests):
+        # grants split by warm-pool hit vs agent acquisition, plus returns.
+        self.lease_grants = 0
+        self.lease_pool_hits = 0
+        self.lease_returns = 0
         # (owner, lease_entry, expiry): reasserted leases whose node agent
         # hasn't re-registered yet (controller restart FT).
         self._parked_reasserts: list[tuple] = []
@@ -492,6 +508,26 @@ class Controller:
         node = self.nodes.get(nid)
         if node is None or not node.alive:
             return False
+        inc = ent.get("incarnation")
+        if inc is not None and inc != node.incarnation:
+            # Fenced: the lease was granted against a previous life of this
+            # node — its worker died with that life, so the lease is dead on
+            # arrival (charging its resources would oversubscribe the fresh
+            # life). Consumed, not parked; the owner fails its in-flight
+            # specs over on the invalidation.
+            self.stale_incarnation_rejections += 1
+            logger.warning(
+                "rejected stale-incarnation lease %s for node %s "
+                "(incarnation %s, current %s)", lid[:8], nid[:8], inc,
+                node.incarnation)
+            oconn = self.client_conns.get(owner)
+            if oconn is not None and not oconn.closed:
+                try:
+                    oconn.push_threadsafe("lease_invalid", lease_id=lid,
+                                          cause="stale node incarnation")
+                except Exception:
+                    pass
+            return True
         demand = ResourceSet(_raw=ent["resources"])
         try:
             self._consume_for(nid, ent["strategy"], demand)
@@ -501,8 +537,10 @@ class Controller:
             "owner": owner,
             "node_id": nid,
             "worker_id": ent["worker_id"],
+            "address": tuple(ent["address"]) if ent.get("address") else None,
             "demand": demand.raw(),
             "strategy": ent["strategy"],
+            "incarnation": node.incarnation,
         }
         return True
 
@@ -635,6 +673,15 @@ class Controller:
     # ---------------------------------------------------------- scheduling
     def _kick(self):
         self._sched_wakeup.set()
+        if self._lease_waiter_futs:
+            self._kick_leases()
+
+    def _kick_leases(self):
+        """Wake parked lease requests (capacity may have freed)."""
+        waiters, self._lease_waiter_futs = self._lease_waiter_futs, []
+        for fut in waiters:
+            if not fut.done():
+                fut.set_result(None)
 
     async def _schedule_loop(self):
         while True:
@@ -754,6 +801,24 @@ class Controller:
         if ent is None:
             return  # batch barrier already failed this spec over; or dup
         spec, demand, nid = ent
+        if a.get("dup"):
+            # The agent already executed this task id on its direct (leased)
+            # path — the spec reaching it again is an owner failover racing
+            # an orphaned completion. At-most-once: don't run it twice; the
+            # dedup record carries the first execution's results, so resolve
+            # them exactly like a task_done (notifies the owner's refs).
+            self._release(nid, spec, demand)
+            try:
+                await self._p_task_done(None, {
+                    "task_id": spec.task_id, "attempt": spec.attempt,
+                    "results": a.get("results") or [],
+                    "error": a.get("error"),
+                    "retryable": a.get("retryable", False), "spec": spec})
+            except Exception:
+                logger.exception("dedup completion replay failed for task %s",
+                                 a["task_id"][:12])
+            self._kick()
+            return
         if not a.get("ok"):
             self._release(nid, spec, demand)
             self.pending.append(spec)
@@ -1081,23 +1146,47 @@ class Controller:
         owner = conn.meta.get("worker_id") or a.get("owner_id")
         demand = ResourceSet(_raw=a["resources"])
         strategy = a["strategy"]
-        count = max(1, min(int(a.get("count", 1)), 64))
+        count = max(1, min(int(a.get("count", 1)), max(1, CONFIG.lease_batch)))
         # Fair share under contention: while other requesters are parked
         # waiting for capacity, one owner must not re-grab the whole pool.
         others = max(0, self._lease_waiters)
+        have = int(a.get("have", 0))
+        if have > 0 and others > 0:
+            # Starving requesters (have=0, parked below) get first claim on
+            # freed capacity: a scale-up probe from an owner that already
+            # holds leases must not race them for it.
+            return {"leases": []}
         granted = await self._grant_leases(
             owner, demand, strategy, max(1, count // (1 + others)))
+        if not granted and have > 0:
+            # The requester already holds live leases for this class: this
+            # is a scale-UP probe, not starvation. Answer "no" immediately —
+            # parking it would fire need_resources and steal momentarily-
+            # idle leases from owners who are about to reuse them (the
+            # redistribution thrash behind the multi-client collapse).
+            return {"leases": granted}
         if not granted:
             # Park the request briefly instead of replying empty: ask lease
-            # holders for idle returns and retry — client-side polling at
-            # REQUEST_RETRY_S granularity convoys concurrent submitters on
-            # the idle-return timer (observed 15x multi-client loss).
+            # holders for idle returns and retry when capacity frees —
+            # client-side polling at REQUEST_RETRY_S granularity convoys
+            # concurrent submitters on the idle-return timer (observed 15x
+            # multi-client loss). Parked requests are woken by _kick_leases
+            # the moment a lease returns; the short wait cap only covers
+            # lost wakeups.
             deadline = time.monotonic() + 0.4
             self._lease_waiters += 1
             try:
-                while not granted and time.monotonic() < deadline:
+                while not granted:
+                    rem = deadline - time.monotonic()
+                    if rem <= 0:
+                        break
                     self._maybe_push_need_resources()
-                    await asyncio.sleep(0.02)
+                    fut = asyncio.get_running_loop().create_future()
+                    self._lease_waiter_futs.append(fut)
+                    try:
+                        await asyncio.wait_for(fut, min(rem, 0.05))
+                    except asyncio.TimeoutError:
+                        pass
                     granted = await self._grant_leases(
                         owner, demand, strategy,
                         max(1, count // max(1, self._lease_waiters)))
@@ -1106,12 +1195,15 @@ class Controller:
         return {"leases": granted}
 
     async def _grant_leases(self, owner, demand, strategy, count) -> list:
+        import copy
         import uuid
 
-        import copy
-
-        granted = []
-        for _ in range(count):
+        # Placement pass first: pick/consume up to `count` slots (placement
+        # authority stays entirely with the scheduler), THEN fill each
+        # node's quota — warm pool hits cost no agent round trip, misses
+        # ride ONE bulk `lease_workers` call per node.
+        by_node: dict[str, list] = {}
+        for _ in range(max(1, count)):
             nid = pick_node(demand, strategy, self.nodes, self.pg_bundles)
             if nid is None:
                 break
@@ -1126,31 +1218,122 @@ class Controller:
             # LocalConnection path — into the caller's live strategy object.
             lease_strategy = copy.copy(strategy)
             self._consume_for(nid, lease_strategy, demand)
-            try:
-                # Margin over the agent's own acquire timeout: if the agent
-                # raises first we get a clean error reply; timing out here
-                # first would strand a slot in 'leased' with no lease entry.
-                rep = await nconn.call(
-                    "lease_worker", resources=demand.raw(),
-                    _timeout=CONFIG.worker_register_timeout_s + 5)
-            except Exception:
-                self._release_for(nid, lease_strategy, demand)
-                break
+            by_node.setdefault(nid, []).append(lease_strategy)
+
+        granted = []
+        demand_raw = demand.raw()
+
+        def _mint(nid, lease_strategy, worker_id, address, incarnation):
+            self.lease_grants += 1
             lease_id = uuid.uuid4().hex[:16]
+            addr = tuple(address) if address else None
             self.leases[lease_id] = {
                 "owner": owner,
                 "node_id": nid,
-                "worker_id": rep["worker_id"],
-                "demand": demand.raw(),
+                "worker_id": worker_id,
+                "address": addr,
+                "demand": demand_raw,
                 "strategy": lease_strategy,
+                "incarnation": incarnation,
             }
             granted.append({
                 "lease_id": lease_id,
                 "node_id": nid,
-                "worker_id": rep["worker_id"],
-                "address": tuple(rep["address"]),
+                "worker_id": worker_id,
+                "address": addr,
+                "incarnation": incarnation,
             })
+
+        for nid, strategies in by_node.items():
+            node = self.nodes[nid]
+            rest = []
+            for st in strategies:
+                pooled = self._pool_pop(nid, demand_raw)
+                if pooled is not None:
+                    self.lease_pool_hits += 1
+                    _mint(nid, st, pooled["worker_id"], pooled["address"],
+                          node.incarnation)
+                else:
+                    rest.append(st)
+            if not rest:
+                continue
+            nconn = self.node_conns.get(nid)
+            workers = []
+            if nconn is not None and not nconn.closed:
+                try:
+                    # Margin over the agent's own acquire timeout: if the
+                    # agent raises first we get a clean error reply; timing
+                    # out here first would strand slots in 'leased' with no
+                    # lease entry.
+                    rep = await nconn.call(
+                        "lease_workers", count=len(rest),
+                        resources=demand_raw,
+                        _timeout=CONFIG.worker_register_timeout_s + 5)
+                    workers = rep.get("workers") or []
+                except Exception:
+                    workers = []
+            # The node may have died/bounced during the agent call: minting
+            # a lease against the stale life would leak its accounting.
+            node = self.nodes.get(nid)
+            if node is None or not node.alive:
+                for st in rest:
+                    self._release_for(nid, st, demand)
+                continue
+            for st, w in zip(rest, workers):
+                _mint(nid, st, w["worker_id"], w["address"], node.incarnation)
+            for st in rest[len(workers):]:
+                self._release_for(nid, st, demand)
         return granted
+
+    # -- warm lease pool ---------------------------------------------------
+    def _pool_pop(self, nid: str, demand_raw: dict):
+        pool = self.lease_pool.get(nid)
+        if not pool:
+            return None
+        now = time.monotonic()
+        for i, ent in enumerate(pool):
+            if ent["expires"] > now and ent["demand"] == demand_raw:
+                self._lease_pool_size -= 1
+                return pool.pop(i)
+        return None
+
+    def _drop_node_pool(self, nid: str):
+        """Forget a node's warm pool (death / reconcile: the slots are
+        gone, or the inventory sweep will unlease them)."""
+        dropped = self.lease_pool.pop(nid, None)
+        if dropped:
+            self._lease_pool_size -= len(dropped)
+
+    async def _unlease(self, nid: str, worker_id: str):
+        nconn = self.node_conns.get(nid)
+        if nconn is not None and not nconn.closed:
+            try:
+                await nconn.push("unlease_worker", worker_id=worker_id)
+            except Exception:
+                pass
+
+    async def _sweep_lease_pool(self):
+        """Expire warm pool entries (runs from the health loop): the agent
+        finally gets its worker slot back. ALL pool mutation happens before
+        the first await — writing a pre-await snapshot back would resurrect
+        entries popped by a concurrent grant (double-granting one worker
+        slot) and drop entries returned during the await."""
+        now = time.monotonic()
+        to_unlease = []
+        for nid in list(self.lease_pool):
+            pool = self.lease_pool[nid]
+            keep = [e for e in pool if e["expires"] > now]
+            expired = [e for e in pool if e["expires"] <= now]
+            if not expired:
+                continue
+            self._lease_pool_size -= len(expired)
+            if keep:
+                self.lease_pool[nid] = keep
+            else:
+                self.lease_pool.pop(nid, None)
+            to_unlease.extend((nid, e["worker_id"]) for e in expired)
+        for nid, wid in to_unlease:
+            await self._unlease(nid, wid)
 
     def _consume_for(self, nid: str, strategy, demand: ResourceSet):
         if strategy.kind == "PLACEMENT_GROUP":
@@ -1184,16 +1367,31 @@ class Controller:
         return ent
 
     async def _h_return_leases(self, conn, a):
+        keep = CONFIG.lease_idle_s
+        now = time.monotonic()
         for lease_id in a["lease_ids"]:
             ent = self._drop_lease(lease_id)
             if ent is None:
                 continue
-            nconn = self.node_conns.get(ent["node_id"])
-            if nconn is not None and not nconn.closed:
-                try:
-                    await nconn.push("unlease_worker", worker_id=ent["worker_id"])
-                except Exception:
-                    pass
+            self.lease_returns += 1
+            nid = ent["node_id"]
+            node = self.nodes.get(nid)
+            # Keep the returned worker warm: the slot stays 'leased' at the
+            # agent and a matching regrant within the idle window skips the
+            # whole agent round trip (multi-client handoff hot path).
+            if (keep > 0 and node is not None and node.alive
+                    and node.incarnation == ent.get("incarnation",
+                                                    node.incarnation)
+                    and self._lease_pool_size < 256):
+                self.lease_pool.setdefault(nid, []).append({
+                    "worker_id": ent["worker_id"],
+                    "address": ent.get("address"),
+                    "demand": ent["demand"],
+                    "expires": now + keep,
+                })
+                self._lease_pool_size += 1
+                continue
+            await self._unlease(nid, ent["worker_id"])
         return {}
 
     async def _h_kill_leased_worker(self, conn, a):
@@ -1240,9 +1438,19 @@ class Controller:
                 if oconn is not None and not oconn.closed:
                     try:
                         await oconn.push("lease_invalid", lease_id=lease_id,
-                                         cause=cause)
+                                         cause=cause or "worker died")
                     except Exception:
                         pass
+        # A pooled (returned-but-warm) worker dying must leave the pool, or
+        # a later grant would hand out a corpse.
+        for nid, pool in list(self.lease_pool.items()):
+            alive = [e for e in pool if e["worker_id"] != worker_id]
+            if len(alive) != len(pool):
+                self._lease_pool_size -= len(pool) - len(alive)
+                if alive:
+                    self.lease_pool[nid] = alive
+                else:
+                    self.lease_pool.pop(nid, None)
 
     def _maybe_push_need_resources(self):
         """Demand exists that can't place while clients hold leases: ask them
@@ -2025,6 +2233,9 @@ class Controller:
         # kill()ed or restarted away leaves a zombie instance still serving
         # its pipes (exactly one instance may live — reap it); a lease that
         # was returned/reaped leaves the slot stuck 'leased' forever.
+        # Warm-pool entries are forgotten first so their slots fall to the
+        # sweep's unlease too (pool regrants must not outlive a blip).
+        self._drop_node_pool(nid)
         lease_wids = {l["worker_id"] for l in self.leases.values()}
         nconn = self.node_conns.get(nid)
         for w in reported:
@@ -2055,6 +2266,7 @@ class Controller:
             return
         node.liveness = "DEAD"
         self.node_conns.pop(nid, None)
+        self._drop_node_pool(nid)
         self._reconciled_busy = {
             t: (n, r) for t, (n, r) in self._reconciled_busy.items()
             if n != nid}
@@ -2128,6 +2340,11 @@ class Controller:
                 await self._sweep_dying()
             except Exception:
                 logger.exception("dying-object sweep failed")
+            try:
+                if self.lease_pool:
+                    await self._sweep_lease_pool()
+            except Exception:
+                logger.exception("lease-pool sweep failed")
 
     # ----------------------------------------------------- placement groups
     async def _h_create_pg(self, conn, a):

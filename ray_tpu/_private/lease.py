@@ -25,17 +25,46 @@ from collections import deque
 from typing import Optional
 
 from ray_tpu._private import rpc
+from ray_tpu._private.rtconfig import CONFIG
 from ray_tpu._private.serialization import dumps_oob
-from ray_tpu._private.task_spec import TaskSpec
+from ray_tpu._private.task_spec import STREAMING, TaskSpec
 
 logger = logging.getLogger(__name__)
 
 # In-flight pipeline depth per leased worker. Tasks beyond the depth wait in
 # the class queue; the worker executes its pipeline serially in order.
-DEPTH = 8
-MAX_LEASES_PER_CLASS = 16
-IDLE_RETURN_S = 0.5
+# 16 (up from 8): at direct-dispatch rates the pump/flush round trip per
+# burst is the dominant bubble — measured 9.6k -> 14.1k tasks/s on a
+# single saturated lease; still shallow enough that a slow task's
+# head-of-line collateral stays bounded. Lease-count ceiling and
+# idle-return window live in rtconfig (RT_LEASE_BATCH / RT_LEASE_IDLE_S).
+DEPTH = 16
 REQUEST_RETRY_S = 0.1
+# After the controller answers a scale-up request short, the class stops
+# asking for more than it got for this long (a fully-subscribed cluster
+# must not be begged at submit rate — the parked requests would fire
+# need_resources and steal momentarily-idle leases from their owners).
+CAP_PROBE_S = 0.25
+# Per-lease assignment depth while the lease set can still GROW: deep
+# pipelining must not let the first granted lease swallow a whole small
+# batch before its siblings exist (12 slow tasks would all serialize on
+# one worker while a second node sits idle). Once the class holds the
+# cluster's proven capacity, the full DEPTH applies.
+RAMP_DEPTH = 4
+
+_metrics_mod = None
+
+
+def _record_dispatch(path: str, n: int = 1):
+    """Count a task submission route ('direct' vs 'controller') — lazy
+    import keeps the module graph acyclic (util.metrics reaches back into
+    worker for its flusher)."""
+    global _metrics_mod
+    if _metrics_mod is None:
+        from ray_tpu.util import metrics as _m
+
+        _metrics_mod = _m
+    _metrics_mod.record_task_dispatch(path, n)
 
 
 def _class_key(spec: TaskSpec) -> tuple:
@@ -47,14 +76,19 @@ def _class_key(spec: TaskSpec) -> tuple:
 class _Lease:
     __slots__ = ("lease_id", "worker_id", "node_id", "addr", "conn", "inflight",
                  "buf", "flushing", "dead", "idle_since", "cls", "kill_target",
-                 "fail_cause")
+                 "fail_cause", "incarnation")
 
-    def __init__(self, cls, lease_id: str, worker_id: str, node_id: str, addr: tuple):
+    def __init__(self, cls, lease_id: str, worker_id: str, node_id: str,
+                 addr: tuple, incarnation: int | None = None):
         self.cls = cls
         self.lease_id = lease_id
         self.worker_id = worker_id
         self.node_id = node_id
         self.addr = addr
+        # Node incarnation the grant was minted against: echoed in
+        # reasserts so a restarted controller can fence leases from a
+        # node's previous life.
+        self.incarnation = incarnation
         self.conn: Optional[rpc.Connection] = None
         self.inflight: dict[str, TaskSpec] = {}
         self.buf: list[TaskSpec] = []
@@ -70,7 +104,7 @@ class _Lease:
 
 class _Class:
     __slots__ = ("key", "resources", "strategy", "queue", "leases", "requesting",
-                 "depth")
+                 "depth", "cap", "cap_ts", "proven_cap")
 
     def __init__(self, key: tuple, spec: TaskSpec):
         self.key = key
@@ -79,6 +113,16 @@ class _Class:
         self.queue: deque[TaskSpec] = deque()
         self.leases: dict[str, _Lease] = {}
         self.requesting = False
+        # Grant back-off: a short grant sets cap = what the cluster proved
+        # it can give; requests stay under it until the probe window
+        # passes (see CAP_PROBE_S).
+        self.cap: int | None = None
+        self.cap_ts = 0.0
+        # Persistent capacity watermark driving the RAMP_DEPTH->DEPTH
+        # switch. Unlike `cap` it survives the periodic probes (a probe
+        # answered short re-proves it; only a grant that actually GROWS
+        # the set clears it), so steady-state pipelining never dips.
+        self.proven_cap: int | None = None
         # SPREAD must place per task across nodes (reference spread policy),
         # so no pipelining: each task forces its own lease while the queue
         # is non-empty.
@@ -97,12 +141,18 @@ class LeaseManager:
         self._pump_scheduled = False
         self._cancelled: dict[str, bool] = {}  # task_id -> force
         self._idle_task = None
+        # worker_id -> (conn, expires): connections of returned leases kept
+        # warm — the controller pools returned workers for lease_idle_s, so
+        # a regrant usually names a worker we already verified, skipping
+        # the TCP connect + whoami round trips of the handoff hot path.
+        self._conn_cache: dict[str, tuple] = {}
         self._shutdown = False
 
     # ------------------------------------------------------------- submit
     def submit(self, spec: TaskSpec):
         """Called from any thread. Refs/resolutions already registered by
         Worker.submit_task."""
+        _record_dispatch("direct")
         key = _class_key(spec)
         with self._lock:
             cls = self.classes.get(key)
@@ -133,9 +183,17 @@ class LeaseManager:
         # SPREADS across the live leases instead of convoying on one.
         live = [l for l in cls.leases.values()
                 if not l.dead and l.kill_target is None]
+        if cls.depth == 1:  # SPREAD: per-task placement, no pipelining
+            eff_depth = 1
+        elif cls.proven_cap is not None and len(live) >= cls.proven_cap:
+            eff_depth = cls.depth
+        else:
+            # Lease set may still grow: stay shallow so a small batch
+            # leaves queue for the leases about to be granted.
+            eff_depth = RAMP_DEPTH
         while cls.queue and live:
             lease = min(live, key=lambda l: len(l.inflight))
-            room = cls.depth - len(lease.inflight)
+            room = eff_depth - len(lease.inflight)
             if room <= 0:
                 break
             batch = []
@@ -158,8 +216,20 @@ class LeaseManager:
                 asyncio.ensure_future(self._a_flush(lease))
         if cls.queue and not cls.requesting:
             outstanding = len(cls.queue) + sum(len(l.inflight) for l in live)
-            want = min(MAX_LEASES_PER_CLASS, outstanding)
+            want = min(max(1, CONFIG.lease_batch), outstanding)
+            if cls.cap is not None:
+                if time.monotonic() - cls.cap_ts >= CAP_PROBE_S:
+                    cls.cap = None  # probe again: capacity may have freed
+                else:
+                    want = min(want, cls.cap)
             need = want - len(cls.leases)
+            # Slow-start (ask at most double the current holding): under
+            # multi-client contention the first requester must not vacuum
+            # the whole pool and leave its peers starving — redistribution
+            # afterwards costs rounds of need_resources churn. A lone
+            # client still reaches lease_batch in a handful of cheap
+            # doubling grants.
+            need = min(need, max(1, len(cls.leases)))
             if need > 0:
                 cls.requesting = True
                 asyncio.ensure_future(self._a_request(cls, need))
@@ -173,17 +243,31 @@ class LeaseManager:
         return True
 
     async def _a_request(self, cls: _Class, count: int):
+        have = sum(1 for l in cls.leases.values() if not l.dead)
         try:
             rep = await self.w.controller.call(
                 "lease_workers", resources=cls.resources, strategy=cls.strategy,
-                count=count, owner_id=self.w.worker_id)
+                count=count, have=have, owner_id=self.w.worker_id)
         except Exception:
             rep = {"leases": []}
         finally:
             cls.requesting = False
+        if len(rep["leases"]) < count:
+            # The cluster gave less than asked: remember the proven level
+            # and stop begging until the probe window passes.
+            cls.cap = max(1, len(cls.leases) + len(rep["leases"]))
+            cls.cap_ts = time.monotonic()
+            cls.proven_cap = cls.cap
+        else:
+            cls.cap = None
+            if rep["leases"]:
+                # The set actually grew to (or past) what was asked:
+                # capacity is unknown again — ramp shallow until the next
+                # short answer re-proves the ceiling.
+                cls.proven_cap = None
         for g in rep["leases"]:
             lease = _Lease(cls, g["lease_id"], g["worker_id"], g["node_id"],
-                           tuple(g["address"]))
+                           tuple(g["address"]), g.get("incarnation"))
             cls.leases[lease.lease_id] = lease
             self._by_id[lease.lease_id] = lease
             asyncio.ensure_future(self._a_connect(lease))
@@ -196,28 +280,53 @@ class LeaseManager:
                 self._pump(cls)
 
     async def _a_connect(self, lease: _Lease):
-        try:
-            conn = await rpc.connect(
-                *lease.addr, on_push=self._on_worker_push,
-                on_close=self._on_worker_conn_close, timeout=10,
-                label="lease")
-            rep = await conn.call("whoami", _timeout=10)
-            if rep.get("worker_id") != lease.worker_id:
-                await conn.close()
-                raise ConnectionError("stale lease address (port reused)")
-        except Exception as e:
-            logger.warning("lease %s connect failed: %s", lease.lease_id[:8], e)
-            self._lease_failed(lease, release=True)
-            return
+        cached = self._conn_cache.pop(lease.worker_id, None)
+        if cached is not None and not cached[0].closed:
+            # Warm-pool regrant of a worker we already talked to: the
+            # connection's identity was verified when first established and
+            # a connection to a dead worker closes, so reuse it as-is — no
+            # TCP connect, no whoami round trip.
+            conn = cached[0]
+        else:
+            try:
+                conn = await rpc.connect(
+                    *lease.addr, on_push=self._on_worker_push,
+                    on_close=self._on_worker_conn_close, timeout=10,
+                    label="lease")
+                rep = await conn.call("whoami", _timeout=10)
+                if rep.get("worker_id") != lease.worker_id:
+                    await conn.close()
+                    raise ConnectionError("stale lease address (port reused)")
+            except Exception as e:
+                logger.warning("lease %s connect failed: %s",
+                               lease.lease_id[:8], e)
+                self._lease_failed(lease)
+                return
         lease.conn = conn
         self._by_conn[conn] = lease
         if lease.dead:  # invalidated while connecting
-            await conn.close()
+            self._park_conn(lease)
             return
         self._pump(lease.cls)
         if lease.buf and not lease.flushing:
             lease.flushing = True
             asyncio.ensure_future(self._a_flush(lease))
+
+    def _park_conn(self, lease: _Lease):
+        """Detach and cache a (healthy) lease connection for reuse by a
+        later grant of the same worker; close it when the cache is full."""
+        conn = lease.conn
+        lease.conn = None
+        if conn is None:
+            return
+        self._by_conn.pop(conn, None)
+        if conn.closed:
+            return
+        if len(self._conn_cache) >= 32:
+            asyncio.ensure_future(conn.close())
+            return
+        self._conn_cache[lease.worker_id] = (
+            conn, time.monotonic() + CONFIG.lease_idle_s + 2.0)
 
     async def _a_flush(self, lease: _Lease):
         while True:
@@ -230,14 +339,27 @@ class LeaseManager:
                 lease.flushing = False
                 return
             try:
-                await lease.conn.push("exec_tasks", specs=batch)
+                # Compact wire form (see TaskSpec.task_call_tuple): the
+                # frame-constant owner + class resources ride once; per-spec
+                # fields go as tuples instead of full 24-field spec pickles.
+                await lease.conn.push(
+                    "exec_tasks",
+                    common=(self.w.worker_id, self.w.server_addr,
+                            lease.cls.resources),
+                    calls=[s.task_call_tuple() for s in batch])
             except Exception:
                 lease.flushing = False
-                self._lease_failed(lease, release=False)
+                self._lease_failed(lease)
                 return
 
     # ----------------------------------------------------------- results
     async def _on_worker_push(self, conn, method, a):
+        if method == "gen_items":
+            # Needs no lease binding: trailing stream items may arrive on a
+            # connection that was parked in the cache after its lease
+            # retired (the old path closed the conn and lost them anyway).
+            self.w._on_gen_items(conn, a["items"])
+            return
         lease = self._by_conn.get(conn)
         if lease is None:
             return
@@ -246,23 +368,22 @@ class LeaseManager:
                 self._task_done(lease, item)
             lease.idle_since = time.monotonic()
             self._pump(lease.cls)
-        elif method == "gen_items":
-            self.w._on_gen_items(conn, a["items"])
 
-    def _task_done(self, lease: _Lease, item: dict):
-        spec = lease.inflight.pop(item["task_id"], None)
+    def _task_done(self, lease: _Lease, item: tuple):
+        # item: (task_id, attempt, results, error, retryable, exec_failure)
+        tid, _attempt, results, error, retryable, _ef = item
+        spec = lease.inflight.pop(tid, None)
         if spec is None:
-            self._cancelled.pop(item["task_id"], None)
+            self._cancelled.pop(tid, None)
             return
-        self._cancelled.pop(spec.task_id, None)
-        error = item.get("error")
-        if (error is not None and item.get("retryable")
+        self._cancelled.pop(tid, None)
+        if (error is not None and retryable
                 and spec.attempt < spec.max_retries):
             spec.attempt += 1
             with self._lock:
                 lease.cls.queue.appendleft(spec)
             return
-        for oid, inline, size, holder in item.get("results", []):
+        for oid, inline, size, holder in results or ():
             res = self.w._resolutions.get(oid)
             if res is not None:
                 res.resolve(inline, [tuple(holder)] if holder else [], error)
@@ -279,9 +400,7 @@ class LeaseManager:
         lease.dead = True
         lease.cls.leases.pop(lease.lease_id, None)
         self._by_id.pop(lease.lease_id, None)
-        if lease.conn is not None:
-            self._by_conn.pop(lease.conn, None)
-            asyncio.ensure_future(lease.conn.close())
+        self._park_conn(lease)
         asyncio.ensure_future(self._a_return([lease.lease_id]))
 
     def _fail_spec(self, spec: TaskSpec, blob: dict):
@@ -295,16 +414,35 @@ class LeaseManager:
     # ----------------------------------------------------------- failure
     def _on_worker_conn_close(self, conn):
         lease = self._by_conn.pop(conn, None)
+        for wid, (c, _exp) in list(self._conn_cache.items()):
+            if c is conn:
+                self._conn_cache.pop(wid, None)
         if not self._shutdown:
             self.w._gen_conn_lost(conn)
         if lease is not None and not self._shutdown:
-            self._lease_failed(lease, release=False)
+            self._lease_failed(lease)
 
-    def _lease_failed(self, lease: _Lease, release: bool):
-        """Worker/connection died. Retry its in-flight specs (attempt++) or
-        fail them; drop the lease. The controller learns of worker death from
-        the node agent and releases resources; `release` covers the
-        connect-failed case where no such signal will come."""
+    def _lease_failed(self, lease: _Lease):
+        """Worker/connection died; drop the lease and re-route its specs.
+
+        Transport sever (no known cause — the worker may well be alive and
+        still executing its pipeline): SENT specs fail over to the classic
+        CONTROLLER path without burning an attempt. At-most-once holds
+        because the worker skips the unstarted specs of a dead holder
+        connection and reports the one that WAS executing to its node
+        agent, whose task-id dedup parks/absorbs the failover re-dispatch.
+        (A worker that really died mid-task leaves no record, so the
+        failover re-executes it — the same at-least-once window every
+        retry has.)
+
+        Known worker death (lease_invalid / OOM / force-kill) keeps the
+        original owner-side retry semantics.
+
+        The lease id is ALWAYS returned to the controller: for a
+        severed-but-alive worker that's what frees (and warm-pools) the
+        slot — the old keep-the-lease behavior leaked it until the owner
+        process exited; for a dead worker the return races the agent's
+        worker_died report and loses harmlessly."""
         if lease.dead:
             return
         lease.dead = True
@@ -313,6 +451,7 @@ class LeaseManager:
         if lease.conn is not None:
             self._by_conn.pop(lease.conn, None)
         requeue = []
+        failover = []
         # Specs still in lease.buf provably never reached the worker; of the
         # rest, worker exec order == arrival order and _task_done pops
         # completions, so the OLDEST remaining SENT spec is the one that may
@@ -321,6 +460,8 @@ class LeaseManager:
         unsent = {s.task_id for s in lease.buf}
         executing_candidate = next(
             (tid for tid in lease.inflight if tid not in unsent), None)
+        sever = (lease.fail_cause is None and lease.kill_target is None
+                 and CONFIG.direct_dispatch)
         for spec in lease.inflight.values():
             force = self._cancelled.pop(spec.task_id, None)
             if force is not None:
@@ -331,6 +472,11 @@ class LeaseManager:
                 # Never sent: requeue without burning an attempt, whatever
                 # killed the worker.
                 requeue.append(spec)
+            elif sever and spec.num_returns != STREAMING:
+                # Sent to a worker we can no longer talk to: controller
+                # failover (streaming specs stay on the lease path — the
+                # controller transport has no item stream).
+                failover.append(spec)
             elif (lease.kill_target is not None
                   and spec.task_id != executing_candidate):
                 # The worker was killed to force-cancel ONE task; this spec is
@@ -358,16 +504,22 @@ class LeaseManager:
             with self._lock:
                 for spec in reversed(requeue):
                     lease.cls.queue.appendleft(spec)
-        if release:
-            asyncio.ensure_future(self._a_return([lease.lease_id]))
+        asyncio.ensure_future(self._a_return([lease.lease_id]))
+        if failover:
+            logger.warning(
+                "lease %s severed: failing %d in-flight spec(s) over to the "
+                "controller path", lease.lease_id[:8], len(failover))
+            self.w.submit_specs_via_controller(failover)
         if lease.cls.queue:
             self._pump(lease.cls)
 
     def on_lease_invalid(self, lease_id: str, cause: str | None = None):
         lease = self._by_id.get(lease_id)
         if lease is not None:
-            lease.fail_cause = cause
-            self._lease_failed(lease, release=False)
+            # A controller invalidation IS a known worker death (the agent
+            # reported it): keep retry semantics, don't treat as a sever.
+            lease.fail_cause = cause or "worker died"
+            self._lease_failed(lease)
 
     # -------------------------------------------------------- cancellation
     def cancel(self, task_id: str, force: bool) -> bool:
@@ -457,7 +609,7 @@ class LeaseManager:
         if lease.dead:
             return
         if delivered:
-            self._lease_failed(lease, release=False)
+            self._lease_failed(lease)
         elif lease.kill_target == task_id:
             lease.kill_target = None
             self._pump(lease.cls)
@@ -465,7 +617,7 @@ class LeaseManager:
     # ------------------------------------------------------ lease returns
     async def _a_idle_loop(self):
         while not self._shutdown:
-            await asyncio.sleep(0.25)
+            await asyncio.sleep(min(0.25, max(0.05, CONFIG.lease_idle_s / 2)))
             now = time.monotonic()
             to_return = []
             for cls in self.classes.values():
@@ -473,20 +625,21 @@ class LeaseManager:
                     continue
                 for lease in list(cls.leases.values()):
                     if (not lease.dead and not lease.inflight and not lease.buf
-                            and now - lease.idle_since > IDLE_RETURN_S):
+                            and now - lease.idle_since > CONFIG.lease_idle_s):
                         lease.dead = True
                         cls.leases.pop(lease.lease_id, None)
                         self._by_id.pop(lease.lease_id, None)
                         to_return.append(lease)
             if to_return:
                 for lease in to_return:
-                    if lease.conn is not None:
-                        self._by_conn.pop(lease.conn, None)
-                        try:
-                            await lease.conn.close()
-                        except Exception:
-                            pass
+                    self._park_conn(lease)
                 await self._a_return([l.lease_id for l in to_return])
+            # Cache sweep: drop dead or expired parked connections.
+            for wid, (c, exp) in list(self._conn_cache.items()):
+                if c.closed or exp < now:
+                    self._conn_cache.pop(wid, None)
+                    if not c.closed:
+                        asyncio.ensure_future(c.close())
 
     def reassert(self):
         """After a controller restart: re-declare every live lease so the
@@ -501,6 +654,8 @@ class LeaseManager:
                 "lease_id": lease.lease_id,
                 "worker_id": lease.worker_id,
                 "node_id": lease.node_id,
+                "address": lease.addr,
+                "incarnation": lease.incarnation,
                 "resources": lease.cls.resources,
                 "strategy": lease.cls.strategy,
             })
@@ -523,12 +678,7 @@ class LeaseManager:
                     lease.dead = True
                     cls.leases.pop(lease.lease_id, None)
                     self._by_id.pop(lease.lease_id, None)
-                    if lease.conn is not None:
-                        self._by_conn.pop(lease.conn, None)
-                        try:
-                            await lease.conn.close()
-                        except Exception:
-                            pass
+                    self._park_conn(lease)
                     to_return.append(lease.lease_id)
         if to_return:
             await self._a_return(to_return)
@@ -547,3 +697,10 @@ class LeaseManager:
                 self.w.io.run(self._a_return(ids), timeout=2)
             except Exception:
                 pass
+        cached, self._conn_cache = list(self._conn_cache.values()), {}
+        for c, _exp in cached:
+            if not c.closed:
+                try:
+                    self.w.io.spawn(c.close())
+                except Exception:
+                    pass

@@ -86,6 +86,15 @@ class NodeAgent:
         # /api/stacks probes share one append-mode dump file per pid, and
         # an unserialized second truncate would cut the first's read short.
         self._stack_locks: dict[int, asyncio.Lock] = {}
+        # Direct-path task dedup (at-most-once across owner failover): a
+        # leased worker whose owner connection severed reports the spec it
+        # is still running (`ltask_running`) and its eventual outcome
+        # (`ltask_done`). A controller re-dispatch of the same task id —
+        # the owner failing the spec over — waits for the running entry to
+        # resolve, then replies `dup` with the recorded results instead of
+        # executing twice. task_id -> {"state", "worker_id", "results",
+        # "error", "retryable", "event", "expires"}.
+        self._direct_tasks: dict[str, dict] = {}
 
     async def start(self) -> int:
         self._idle_waiters = deque()
@@ -227,6 +236,18 @@ class NodeAgent:
             # cold spawn sharing its batch. The call reply is the barrier:
             # it follows every push on this ordered connection.
             async def _one(spec):
+                dup = await self._consume_direct_dup(spec.task_id,
+                                                     spec.attempt)
+                if dup is not None:
+                    out = {"task_id": spec.task_id, "ok": True, "dup": True,
+                           "worker_id": None, "results": dup.get("results"),
+                           "error": dup.get("error"),
+                           "retryable": dup.get("retryable", False)}
+                    try:
+                        await conn.push("dispatched", **out)
+                    except Exception:
+                        pass
+                    return out
                 try:
                     rep = await self._dispatch(spec)
                     out = {"task_id": spec.task_id, "ok": True,
@@ -242,20 +263,37 @@ class NodeAgent:
 
             results = await asyncio.gather(*[_one(s) for s in a["specs"]])
             return {"results": list(results)}
-        if method == "lease_worker":
-            slot = await self._acquire_pool_worker()
-            if conn.closed:
-                # The controller died while we were acquiring: the reply can
-                # never be delivered, and marking the slot leased would
-                # orphan it FOREVER (no owner will ever return it) while its
-                # ghost acquisition starves real waiters after the
-                # controller restarts. Re-idle and fail the dead request.
-                self._worker_became_idle(slot)
-                raise rpc.RpcError("controller connection closed mid-lease")
-            slot.state = "leased"
-            slot.assigned_at = time.monotonic()
-            slot.held_resources = a.get("resources")
-            return {"worker_id": slot.worker_id, "address": slot.address}
+        if method in ("lease_worker", "lease_workers"):
+            count = max(1, int(a.get("count", 1)))
+
+            async def _lease_one():
+                try:
+                    slot = await self._acquire_pool_worker()
+                except Exception:
+                    return None
+                if conn.closed:
+                    # The controller died while we were acquiring: the reply
+                    # can never be delivered, and marking the slot leased
+                    # would orphan it FOREVER (no owner will ever return it)
+                    # while its ghost acquisition starves real waiters after
+                    # the controller restarts. Re-idle the slot.
+                    self._worker_became_idle(slot)
+                    return None
+                slot.state = "leased"
+                slot.assigned_at = time.monotonic()
+                slot.held_resources = a.get("resources")
+                return {"worker_id": slot.worker_id, "address": slot.address}
+
+            # The whole batch acquires concurrently (slot reservation is
+            # synchronous, so no double-grant) and partial fills are fine —
+            # the controller releases what it placed but didn't get.
+            out = [w for w in await asyncio.gather(
+                *[_lease_one() for _ in range(count)]) if w is not None]
+            if method == "lease_worker":  # single-grant wire compat
+                if not out:
+                    raise rpc.RpcError("no worker available for lease")
+                return out[0]
+            return {"workers": out}
         if method == "worker_stacks":
             return await self._worker_stacks(a["worker_id"])
         if method == "run_job":
@@ -484,6 +522,28 @@ class NodeAgent:
                     self._kill_slot(slot)
                 else:
                     self._worker_became_idle(slot)
+        elif method == "ltask_running":
+            # A leased worker's owner connection severed mid-task: the spec
+            # it is still executing is recorded so an owner-failover
+            # re-dispatch of the same id parks instead of double-executing.
+            rec = self._direct_tasks.get(a["task_id"])
+            if rec is None:  # an already-arrived ltask_done wins
+                self._direct_tasks[a["task_id"]] = {
+                    "state": "running", "worker_id": a.get("worker_id"),
+                    "attempt": a.get("attempt", 0),
+                    "event": asyncio.Event(),
+                    "expires": time.monotonic() + 600.0}
+        elif method == "ltask_done":
+            rec = self._direct_tasks.get(a["task_id"])
+            if rec is None:
+                rec = self._direct_tasks[a["task_id"]] = {
+                    "event": asyncio.Event()}
+            rec.update(state="done", worker_id=a.get("worker_id"),
+                       attempt=a.get("attempt", 0),
+                       results=a.get("results"), error=a.get("error"),
+                       retryable=a.get("retryable", False),
+                       expires=time.monotonic() + 600.0)
+            rec["event"].set()
 
     def _on_worker_conn_close(self, conn):
         wid = conn.meta.get("worker_id")
@@ -491,6 +551,41 @@ class NodeAgent:
             asyncio.ensure_future(self._worker_exited(self.workers[wid], "connection lost"))
 
     # ---------------------------------------------------------- dispatch
+    async def _consume_direct_dup(self, task_id: str, attempt: int = 0):
+        """At-most-once guard for owner failover: if this (task id,
+        attempt) already ran (or is still running) on a leased worker
+        whose owner connection severed, return the recorded outcome
+        instead of letting the dispatch execute it a second time. None =
+        never seen here, execute normally. The attempt must match: a
+        lineage-reconstruction resubmit of the same task id carries
+        attempt+1 and MUST re-execute, not replay a stale record whose
+        holders may point at the very object that was lost. A running
+        record resolves on the worker's ltask_done or its death (death
+        clears the record — the task never finished, so the re-dispatch
+        may run); the wait is bounded so a lost ltask_done push cannot
+        park the dispatch forever."""
+        rec = self._direct_tasks.get(task_id)
+        if rec is None or rec.get("attempt", 0) != attempt:
+            return None
+        if rec.get("state") == "running":
+            try:
+                await asyncio.wait_for(rec["event"].wait(), 600.0)
+            except asyncio.TimeoutError:
+                pass  # worker alive but outcome lost: fall through, execute
+        rec = self._direct_tasks.pop(task_id, None)
+        if rec is None or rec.get("state") != "done" \
+                or rec.get("attempt", 0) != attempt:
+            return None
+        return rec
+
+    def _purge_direct_tasks(self, worker_id: str):
+        """The worker behind running dedup records died: the tasks never
+        finished, so clear the records and unpark waiting dispatches."""
+        for tid, rec in list(self._direct_tasks.items()):
+            if rec.get("state") == "running" and rec.get("worker_id") == worker_id:
+                self._direct_tasks.pop(tid, None)
+                rec["event"].set()
+
     async def _dispatch(self, spec: TaskSpec) -> dict:
         slot = await self._acquire_worker(spec)
         slot.task_id = spec.task_id
@@ -676,6 +771,11 @@ class NodeAgent:
             for wid, slot in list(self.workers.items()):
                 if slot.proc.poll() is not None and slot.state != "dead":
                     await self._worker_exited(slot, f"exit code {slot.proc.returncode}")
+            if self._direct_tasks:
+                now = time.monotonic()
+                for tid, rec in list(self._direct_tasks.items()):
+                    if rec.get("state") == "done" and rec["expires"] < now:
+                        self._direct_tasks.pop(tid, None)
             keep = CONFIG.idle_worker_keep_s
             if keep > 0:
                 idle = [s for s in self.workers.values() if s.state == "idle" and not s.dedicated]
@@ -689,10 +789,12 @@ class NodeAgent:
                              cause: str | None = None):
         if slot.state == "dead":
             self.workers.pop(slot.worker_id, None)
+            self._purge_direct_tasks(slot.worker_id)
             return
         prev_state = slot.state
         slot.state = "dead"
         self.workers.pop(slot.worker_id, None)
+        self._purge_direct_tasks(slot.worker_id)
         if prev_state in ("busy", "actor", "leased") or slot.actor_id:
             try:
                 await self.controller.push(

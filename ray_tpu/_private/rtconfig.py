@@ -82,6 +82,20 @@ _flag("memory_monitor_refresh_ms", int, 250)
 _flag("object_chunk_bytes", int, 16 * 1024 * 1024)
 _flag("pull_max_inflight_bytes", int, 512 * 1024 * 1024)
 _flag("max_pending_calls_default", int, -1)
+# Owner-side direct task dispatch (README "Ownership & direct dispatch"):
+# owners lease workers from the controller and push plain-task specs to
+# them directly, keeping the controller off the per-task hot path. False
+# routes every plain task through controller dispatch (the classic path —
+# also the failover target when a direct connection severs).
+_flag("direct_dispatch", bool, True)
+# Max leases granted/requested per batch (one grant amortizes over many
+# tasks; the agent acquires a node's whole batch concurrently in one RPC).
+_flag("lease_batch", int, 16)
+# Idle lease lifecycle: owners return leases idle for this long, and the
+# controller keeps returned leases warm in a per-node pool for the same
+# window before telling the agent to unlease the worker (a regrant from
+# the pool costs no agent round trip and usually no new owner connection).
+_flag("lease_idle_s", float, 0.5)
 # Streaming generators: executor pauses once this many yielded items are
 # unacknowledged by the consumer (reference
 # _generator_backpressure_num_objects); <=0 disables backpressure.

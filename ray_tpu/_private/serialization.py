@@ -47,11 +47,10 @@ class SerializedObject:
             p if isinstance(p, (bytes, bytearray)) else bytes(p)
             for p in self.to_parts())
 
-    def to_parts(self) -> list:
-        """Same byte stream as to_bytes() but as a list of parts, so the shm
-        store can write each raw buffer straight into the mmap — one copy
-        total on the put path (reference plasma writes once into shm;
-        round-1 joined everything first = two extra full copies)."""
+    def to_parts_meta(self) -> bytes:
+        """The fixed-size prefix of the wire layout (ref table + counts +
+        header length) — the single source of truth shared by to_parts()
+        and the store's serialize-into-shm put_serialized()."""
         ref_oids = [r.hex() if hasattr(r, "hex") else r for r in self.contained_refs]
         meta = [struct.pack("<I", len(ref_oids))]
         for h in ref_oids:
@@ -60,7 +59,14 @@ class SerializedObject:
             meta.append(hb)
         meta.append(struct.pack("<I", len(self.buffers)))
         meta.append(struct.pack("<Q", len(self.header)))
-        parts = [b"".join(meta), self.header]
+        return b"".join(meta)
+
+    def to_parts(self) -> list:
+        """Same byte stream as to_bytes() but as a list of parts, so the shm
+        store can write each raw buffer straight into the mmap — one copy
+        total on the put path (reference plasma writes once into shm;
+        round-1 joined everything first = two extra full copies)."""
+        parts = [self.to_parts_meta(), self.header]
         for b in self.buffers:
             parts.append(struct.pack("<Q", len(b)))
             parts.append(b)

@@ -18,6 +18,8 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import pickle
+import struct
 import threading
 import time
 import traceback
@@ -28,6 +30,7 @@ from typing import Any, Optional
 from ray_tpu import exceptions as exc
 from ray_tpu._private import rpc
 from ray_tpu._private.ids import ObjectID, TaskID, WorkerID
+from ray_tpu._private.lease import LeaseManager, _record_dispatch
 from ray_tpu._private.object_store import LocalStore
 from ray_tpu._private.resources import ResourceSet
 from ray_tpu._private.rtconfig import CONFIG
@@ -417,6 +420,12 @@ class Worker:
         self._submit_lock = threading.Lock()
         self._submit_buf: list = []
         self._submit_flushing = False
+        # Actor pipes with queued calls awaiting a pump: a same-tick burst
+        # across N pipes costs ONE cross-thread loop wakeup, not N (the
+        # self-pipe write behind run_coroutine_threadsafe is >100us on
+        # some sandboxes — it was ~18% of the n:n driver budget).
+        self._pump_pipes: list = []
+        self._pipe_pump_scheduled = False
         # Streaming generators owned by this process: task_id -> _GenState.
         self._generators: dict[str, _GenState] = {}
         # Hooks used by worker_proc: consumer acks for generator
@@ -431,12 +440,11 @@ class Worker:
         self.actor_batch_handler = None  # def (conn, list[spec]) — one frame
         # Hooks used by worker_proc for the direct (leased) task path:
         self.task_push_handler = None  # def (conn, spec) — enqueue for exec
+        self.task_batch_handler = None  # def (conn, list[spec]) — one frame
         self.task_cancel_handler = None  # def (task_id)
         # Fires when an inbound connection to this worker's server closes
         # (worker_proc prunes per-connection reply pushers here).
         self.server_close_handler = None  # def (conn)
-        from ray_tpu._private.lease import LeaseManager
-
         self.lease_mgr = LeaseManager(self)
         self._shutdown = False
         self._reconnecting = False  # single-flight controller reconnect
@@ -596,8 +604,22 @@ class Worker:
         # Direct (leased) task path: owners stream specs straight to this
         # worker's server (reference PushNormalTask, core_worker.proto:462).
         if method == "exec_tasks":
-            if self.task_push_handler is not None:
-                for spec in a["specs"]:
+            specs = a.get("specs")
+            if specs is None:  # compact form (TaskSpec.task_call_tuple)
+                owner_id, owner_addr, resources = a["common"]
+                owner_addr = tuple(owner_addr) if owner_addr else None
+                specs = [
+                    TaskSpec.for_normal_call(c, owner_id, owner_addr,
+                                             resources)
+                    for c in a["calls"]]
+            if self.task_batch_handler is not None:
+                # Whole frame as ONE exec-queue item (same shape as the
+                # actor_calls path): per-spec queue put/get + condition
+                # notify was a measurable slice of a leased worker's core
+                # budget at direct-dispatch rates.
+                self.task_batch_handler(conn, specs)
+            elif self.task_push_handler is not None:
+                for spec in specs:
                     self.task_push_handler(conn, spec)
         elif method == "actor_calls":
             if self.actor_batch_handler is not None:
@@ -825,7 +847,10 @@ class Worker:
                     "register_put", oid=oid, size=size, inline=parts,
                     holder=self.server_addr, owner=self.worker_id)
         else:
-            self.store.put(oid, sobj.to_parts())
+            # Serialize-into-shm: the pickle-5 out-of-band buffer views go
+            # straight into the destination mmap (no intermediate parts
+            # walk; threaded copy per buffer).
+            self.store.put_serialized(oid, sobj)
             holder = self.agent_addr or self.server_addr
             if register:
                 self.controller.push_threadsafe(
@@ -849,20 +874,20 @@ class Worker:
 
     def _get_one(self, ref: ObjectRef, deadline):
         oid = ref.hex()
-        # 1. owned refs already resolved: straight to materialize (the hot
-        # path for harvesting a batch of results — skips two cache probes)
+        # 1. owned refs: resolved -> straight to materialize (the hot path
+        # for harvesting a batch of results); pending -> wait. The local
+        # cache/shm probes are skipped either way: an owned object's bytes
+        # cannot be locally visible before its resolution lands, and the
+        # miss costs a stat per get() racing its producer.
         res = self._resolutions.get(oid)
-        if res is not None and res.done:
+        if res is not None:
+            if not res.done and not res.wait(timeout=self._remaining(deadline)):
+                raise exc.GetTimeoutError(f"get() timed out on {oid[:16]}")
             return self._materialize(oid, res.inline, res.holders, res.error, deadline)
         # 2. local caches (in-process inline / same-host shm, zero-copy)
         val, found = self._try_local(oid)
         if found:
             return val
-        # 3. owned refs: wait for the controller's object_ready push
-        if res is not None:
-            if not res.wait(timeout=self._remaining(deadline)):
-                raise exc.GetTimeoutError(f"get() timed out on {oid[:16]}")
-            return self._materialize(oid, res.inline, res.holders, res.error, deadline)
         # 3. borrowed refs: ask the controller directly
         rep = self.io.run(self.controller.call(
             "wait_object", oid=oid, timeout=self._remaining(deadline)))
@@ -887,7 +912,11 @@ class Worker:
             raise self._decode_error(error)
         if inline is not None:
             blob = inline[0] if len(inline) == 1 else b"".join(bytes(p) for p in inline)
-            self._inline_cache[oid] = [blob]
+            if oid not in self._resolutions:
+                # Cache for repeat gets of BORROWED refs only: owned refs
+                # re-materialize from their resolution (step 1 of _get_one
+                # never consults the cache), so the write was pure churn.
+                self._inline_cache[oid] = [blob]
             return self._deserialize_blob(memoryview(blob))
         val, found = self._try_local(oid)
         if found:
@@ -1091,7 +1120,16 @@ class Worker:
         asyncio.ensure_future(self.controller.call("submit_task", spec=spec))
         return True
 
+    _NO_REFS_NO_BUFS = b"\x00" * 8  # [nrefs=0][nbufs=0] wire prefix
+
     def _deserialize_blob(self, mv):
+        # Fast path for the dominant result shape (scalar/None, no embedded
+        # refs, no oob buffers): one loads() straight off the header slice —
+        # skips the SerializedObject parse + ref re-hydration machinery
+        # (~2us/call at n:n harvest rates).
+        if bytes(mv[:8]) == self._NO_REFS_NO_BUFS:
+            (hlen,) = struct.unpack_from("<Q", mv, 8)
+            return pickle.loads(mv[16:16 + hlen])
         return self._deser_with_refs(SerializedObject.from_buffer(mv))
 
     def _deser_with_refs(self, sobj: SerializedObject):
@@ -1542,27 +1580,40 @@ class Worker:
             refs.append(ObjectRef(oid, owned=True, worker=self))
         self._pin_args_until_done(escapes, refs)
         if streaming:
+            # Streaming always rides the direct path (the controller
+            # transport has no item stream), RT_DIRECT_DISPATCH or not.
             gen = self._gen_new(spec)
             self.lease_mgr.submit(spec)
             return gen
         # Direct path: lease workers by scheduling class and stream specs to
         # them (reference NormalTaskSubmitter lease pools). TPU tasks keep
         # the controller-dispatch path — they need a dedicated worker whose
-        # chip lease dies with the process.
-        if not any(k.startswith("TPU") for k in spec.resources):
+        # chip lease dies with the process. RT_DIRECT_DISPATCH=0 routes
+        # everything through the controller (the classic path; also the
+        # perf-gate comparison workload).
+        if (CONFIG.direct_dispatch
+                and not any(k.startswith("TPU") for k in spec.resources)):
             self.lease_mgr.submit(spec)
             return refs
-        # Coalesced one-way submit: bursts of .remote() calls ride one RPC
-        # frame (reference batches task submission through the Cython layer;
-        # here the flusher drains whatever accumulated while the previous
-        # frame was in flight).
+        self.submit_specs_via_controller([spec])
+        return refs
+
+    def submit_specs_via_controller(self, specs: list):
+        """Queue already-built specs on the classic controller dispatch
+        path (TPU tasks, RT_DIRECT_DISPATCH=0, and direct-dispatch
+        failover). Thread-safe; bursts coalesce into one `submit_tasks`
+        frame via the flusher."""
+        _record_dispatch("controller", len(specs))
+        # Coalesced submit: bursts of .remote() calls ride one RPC frame
+        # (reference batches task submission through the Cython layer; here
+        # the flusher drains whatever accumulated while the previous frame
+        # was in flight).
         with self._submit_lock:
-            self._submit_buf.append(spec)
+            self._submit_buf.extend(specs)
             need_flush = not self._submit_flushing
             self._submit_flushing = True
         if need_flush:
             self.io.spawn(self._a_flush_submits())
-        return refs
 
     def cancel_task(self, task_id: str, force: bool):
         """Cancel a task wherever it lives: the owner's lease pipelines (the
@@ -1680,7 +1731,8 @@ class Worker:
         for oid in spec.return_object_ids():
             self._resolutions[oid] = _Resolution()
             refs.append(ObjectRef(oid, owned=True, worker=self))
-        self._pin_args_until_done(escapes, refs)
+        if escapes:
+            self._pin_args_until_done(escapes, refs)
         gen = self._gen_new(spec) if num_returns == STREAMING else None
         pipe = self._actor_pipes.get(actor_id)
         if pipe is None:
@@ -1701,17 +1753,41 @@ class Worker:
             res = self._resolutions.setdefault(oid, _Resolution())
             res.resolve(None, [], [h, *bufs])
 
-    def _apply_actor_reply(self, spec: TaskSpec, rep: dict):
-        if rep.get("exec_failure") and not rep.get("results"):
+    def _apply_actor_reply(self, spec: TaskSpec, rep: tuple):
+        # rep: (task_id, attempt, results, error, retryable, exec_failure)
+        _tid, _attempt, results, error, _retryable, exec_failure = rep
+        if exec_failure and not results:
             # The actor's executor layer failed before results were packaged:
             # fail the refs rather than leaving the caller blocked forever.
             self._fail_actor_call(spec, exc.ActorUnavailableError(
-                f"actor executor failure: {rep['exec_failure']}"))
+                f"actor executor failure: {exec_failure}"))
             return
-        error = rep.get("error")
-        for oid, inline, size, holder in rep.get("results", []):
+        for oid, inline, size, holder in results or ():
             res = self._resolutions.setdefault(oid, _Resolution())
             res.resolve(inline, [tuple(holder)] if holder else [], error)
+
+    def _schedule_pipe_pump(self, pipe: "_ActorPipe"):
+        """Coalesced cross-thread pump scheduling for actor pipes (see
+        _pump_pipes). Called from any thread with pipe.pumping already
+        claimed by the caller."""
+        with self._submit_lock:
+            self._pump_pipes.append(pipe)
+            if self._pipe_pump_scheduled:
+                return
+            self._pipe_pump_scheduled = True
+        self.io.spawn(self._a_pump_pipes())
+
+    async def _a_pump_pipes(self):
+        while True:
+            with self._submit_lock:
+                pipes, self._pump_pipes = self._pump_pipes, []
+                if not pipes:
+                    self._pipe_pump_scheduled = False
+                    return
+            for pipe in pipes:
+                # Fan out ON the loop: one pipe's slow connect must not
+                # stall its siblings' flushes.
+                asyncio.ensure_future(pipe._a_pump())
 
     def kill_actor(self, actor_id: str, no_restart=True):
         self.io.run(self.controller.call("kill_actor", actor_id=actor_id, no_restart=no_restart))
@@ -1758,7 +1834,7 @@ class _ActorPipe:
             need = not self.pumping
             self.pumping = True
         if need:
-            self.w.io.spawn(self._a_pump())
+            self.w._schedule_pipe_pump(self)
 
     async def _a_pump(self):
         while True:
@@ -1850,7 +1926,7 @@ class _ActorPipe:
         if method != "tasks_done":
             return
         for item in a["done"]:
-            ent = self.inflight.pop(item["task_id"], None)
+            ent = self.inflight.pop(item[0], None)
             if ent is None:
                 continue
             self.w._apply_actor_reply(ent[0], item)

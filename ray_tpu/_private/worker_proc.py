@@ -24,6 +24,7 @@ import time
 import traceback
 
 from ray_tpu._private import rpc
+from ray_tpu._private import runtime_env as _rtenv
 from ray_tpu._private.rtconfig import CONFIG
 from ray_tpu._private.serialization import dumps_oob, serialize
 from ray_tpu._private.task_spec import ACTOR_CREATE, ACTOR_TASK, NORMAL, STREAMING, TaskSpec
@@ -104,6 +105,22 @@ class WorkerProc:
         # conn). Lets a cancel that arrives while the exec thread is blocked
         # in an earlier task report the cancellation immediately.
         self._pending_ltasks: dict = {}
+        # Owner-failover bookkeeping: when a lease holder's connection
+        # closes, its not-yet-started specs are skipped (the owner re-routes
+        # them through the controller — running them here would
+        # double-execute) and the spec executing RIGHT NOW is reported to
+        # the node agent as `ltask_running` so a failover re-dispatch of the
+        # same id parks on the agent's dedup record. The lock makes
+        # "pending vs executing" atomic against the prune.
+        self._skip_ltasks: set[str] = set()
+        self._ltask_lock = threading.Lock()
+        self._current_ltask: tuple | None = None  # (task_id, attempt, conn)
+        # conn -> deque of recently completed direct-path reply payloads:
+        # a push "succeeds" once buffered, so a connection dying right
+        # after a completion may strand the reply — the prune republishes
+        # these to the agent's dedup table so the owner's failover
+        # re-dispatch resolves from the record instead of re-executing.
+        self._recent_ltasks: dict = {}
         self._done_pushers: dict = {}  # owner conn -> _BatchPusher
         # Streaming generators (executor side): per-conn item pushers and
         # the consumer-ack table driving backpressure.
@@ -127,6 +144,7 @@ class WorkerProc:
         self.worker.actor_push_handler = self._on_actor_push
         self.worker.actor_batch_handler = self._on_actor_batch
         self.worker.task_push_handler = self._on_task_push
+        self.worker.task_batch_handler = self._on_task_batch
         self.worker.task_cancel_handler = self._cancel_current
         self.worker.gen_ack_handler = self._on_gen_ack
         self.worker.gen_close_handler = self._on_gen_close
@@ -147,6 +165,30 @@ class WorkerProc:
         def _prune(conn):
             self._done_pushers.pop(conn, None)
             self._gen_pushers.pop(conn, None)
+            # Owner failover: specs from this holder that haven't started
+            # must never run here (the owner re-submits them through the
+            # controller); the one executing right now is flagged to the
+            # agent so the failover re-dispatch dedups on it.
+            running = None
+            with self._ltask_lock:
+                for tid, (spec_, c) in list(self._pending_ltasks.items()):
+                    if c is conn:
+                        self._pending_ltasks.pop(tid, None)
+                        self._skip_ltasks.add(tid)
+                cur = self._current_ltask
+                if cur is not None and cur[2] is conn:
+                    running = cur[:2]
+            if running is not None and self.agent_conn is not None:
+                try:
+                    self.agent_conn.push_threadsafe(
+                        "ltask_running", task_id=running[0],
+                        attempt=running[1], worker_id=self.worker_id)
+                except Exception:
+                    pass
+            with self._ltask_lock:
+                recent = self._recent_ltasks.pop(conn, None)
+            if recent:
+                self._report_orphaned(list(recent))
             with self._gen_cond:
                 self._gen_cond.notify_all()  # unblock backpressure waits
 
@@ -184,6 +226,14 @@ class WorkerProc:
         self._pending_ltasks[spec.task_id] = (spec, conn)
         self.exec_queue.put(("ltask", spec, conn))
         self._prefetch_args(spec)
+
+    def _on_task_batch(self, conn, specs: list):
+        """A whole coalesced exec_tasks frame rides ONE exec-queue item."""
+        for spec in specs:
+            self._pending_ltasks[spec.task_id] = (spec, conn)
+        self.exec_queue.put(("ltask_batch", specs, conn))
+        for spec in specs:
+            self._prefetch_args(spec)
 
     def _prefetch_args(self, spec: TaskSpec):
         """Pre-localize ref arguments while the spec waits in the exec queue
@@ -261,11 +311,10 @@ class WorkerProc:
                                      "message": f"task {spec.name} cancelled"})
                 pusher = self._pusher_for(conn)
                 if pusher is not None:
-                    pusher.add({
-                        "task_id": spec.task_id, "attempt": spec.attempt,
-                        "results": [(oid, None, 0, None)
-                                    for oid in spec.return_object_ids()],
-                        "error": [h, *bufs], "retryable": False})
+                    pusher.add((spec.task_id, spec.attempt,
+                                [(oid, None, 0, None)
+                                 for oid in spec.return_object_ids()],
+                                [h, *bufs], False, None))
             return
         if self._exec_thread_ident == threading.main_thread().ident:
             import signal
@@ -288,6 +337,9 @@ class WorkerProc:
             try:
                 if kind == "ltask":
                     self._execute_leased_task(spec, reply_slot)
+                elif kind == "ltask_batch":
+                    for sp in spec:
+                        self._execute_leased_task(sp, reply_slot)
                 elif kind == "actor_batch":
                     pusher = reply_slot
                     for sp in spec:
@@ -465,8 +517,10 @@ class WorkerProc:
 
     def _reply_value(self, pusher, task_id: str, reply: dict):
         if pusher is not None:  # None once the holder's connection closed
-            reply["task_id"] = task_id
-            pusher.add(reply)  # thread-safe per-conn batched pusher
+            # Compact wire record (see _done_item): dict replies with five
+            # constant keys cost ~2x the pickle of a tuple at n:n rates.
+            pusher.add((task_id, 0, reply.get("results"), reply.get("error"),
+                        False, reply.get("exec_failure")))
 
     def _reply_future(self, pusher, task_id: str, done_future):
         try:
@@ -563,7 +617,7 @@ class WorkerProc:
         size = sobj.total_bytes()
         if size <= CONFIG.max_inline_object_bytes:
             return (oid, [sobj.to_bytes()], size, None)
-        self.worker.store.put(oid, sobj.to_parts())
+        self.worker.store.put_serialized(oid, sobj)
         # Drop the producer's mapping: the agent is the advertised holder,
         # and keeping it would pin freed pages until this worker exits
         # (same-host readers re-attach from the file).
@@ -779,8 +833,6 @@ class WorkerProc:
         for k, v in env_vars.items():
             saved_env[k] = os.environ.get(k)
             os.environ[k] = str(v)
-        from ray_tpu._private import runtime_env as _rtenv
-
         undo_env = lambda: None  # noqa: E731
         self._current_task_id = spec.task_id
         t0 = time.time()
@@ -856,7 +908,38 @@ class WorkerProc:
         for third-party borrowers. No per-task agent involvement — the slot
         stays leased (reference: executing a PushNormalTask on a leased
         worker, task_receiver.h:51)."""
-        self._pending_ltasks.pop(spec.task_id, None)
+        with self._ltask_lock:
+            if spec.task_id in self._skip_ltasks:
+                # The holder's connection died before this spec started:
+                # the owner fails it over to the controller path, so running
+                # it here too would double-execute.
+                self._skip_ltasks.discard(spec.task_id)
+                return
+            self._pending_ltasks.pop(spec.task_id, None)
+            self._current_ltask = (spec.task_id, spec.attempt, conn)
+        try:
+            self._execute_leased_task_inner(spec, conn)
+        finally:
+            with self._ltask_lock:
+                self._current_ltask = None
+
+    def _report_orphaned(self, payloads):
+        """Holder gone with these outcomes possibly undelivered: publish
+        them to the node agent's dedup table (`ltask_done`) so the owner's
+        failover re-dispatch resolves from the record instead of executing
+        the task a second time."""
+        if self.agent_conn is None:
+            return
+        for tid, attempt, results, error, retryable, _ in payloads:
+            try:
+                self.agent_conn.push_threadsafe(
+                    "ltask_done", worker_id=self.worker_id, task_id=tid,
+                    attempt=attempt, results=results, error=error,
+                    retryable=retryable)
+            except Exception:
+                return
+
+    def _execute_leased_task_inner(self, spec: TaskSpec, conn):
         error_blob = None
         value = None
         retryable = False
@@ -867,8 +950,6 @@ class WorkerProc:
         for k, v in env_vars.items():
             saved_env[k] = os.environ.get(k)
             os.environ[k] = str(v)
-        from ray_tpu._private import runtime_env as _rtenv
-
         undo_env = lambda: None  # noqa: E731
         self._current_task_id = spec.task_id
         t0 = time.time()
@@ -913,8 +994,11 @@ class WorkerProc:
             results = self._package_results(spec, None, error_blob)
 
         pusher = self._pusher_for(conn)
-        payload = {"task_id": spec.task_id, "attempt": spec.attempt,
-                   "results": results, "error": error_blob, "retryable": retryable}
+        # Compact `tasks_done` item (parsed by lease._task_done /
+        # _ActorPipe._on_push): (task_id, attempt, results, error,
+        # retryable, exec_failure).
+        payload = (spec.task_id, spec.attempt, results, error_blob,
+                   retryable, None)
         # Don't advertise transient (to-be-retried) errors: the owner will
         # resubmit, and a poisoned directory entry would outlive the retry.
         # Inline results aren't advertised at all: the owner resolves from
@@ -930,13 +1014,40 @@ class WorkerProc:
                         {"oid": oid, "size": size, "inline": inline,
                          "holder": holder, "owner": spec.owner_id,
                          "error": error_blob})
+        delivered = False
         for _ in range(2):  # a late cancel SIGINT must not lose the report
             try:
-                if pusher is not None:  # holder gone: report has no audience
+                if pusher is not None:
                     pusher.add(payload)
+                    delivered = True
                 break
             except KeyboardInterrupt:
                 continue
+        if will_retry or streaming:
+            # The owner's requeue owns a retried outcome, and streaming
+            # specs never ride the controller failover path (it has no item
+            # transport): no dedup record for either.
+            return
+        # At-most-once across owner failover: make the final outcome
+        # durable at the NODE. Holder already gone -> the owner can only
+        # learn it through the failover re-dispatch, whose agent-side dedup
+        # replays the record. Holder still connected -> park the payload
+        # per connection; the prune republishes it only if the connection
+        # dies with the reply possibly unflushed.
+        import collections
+
+        orphaned = None
+        with self._ltask_lock:
+            if delivered and not conn.closed:
+                rq = self._recent_ltasks.get(conn)
+                if rq is None:
+                    rq = self._recent_ltasks[conn] = collections.deque(
+                        maxlen=64)
+                rq.append(payload)
+            else:
+                orphaned = [payload]
+        if orphaned:
+            self._report_orphaned(orphaned)
 
     def _execute_actor_task(self, spec: TaskSpec, conn=None) -> dict:
         error_blob = None

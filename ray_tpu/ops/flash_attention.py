@@ -45,6 +45,32 @@ def _auto_block(dim: int, preferred: int, align: int) -> int | None:
     return best
 
 
+def derive_blocks(sq: int, sk: int, block_q: int | None = None,
+                  block_k: int | None = None) -> tuple[int, int]:
+    """Resolve the (block_q, block_k) pair for a [Sq, Sk] problem, CLAMPED
+    to valid TPU tiles — block_q on the sublane grid (8), block_k on the
+    lane grid (128). Explicit blocks are treated as preferences (upper
+    bounds) and re-clamped the same way, so a caller-supplied 1024 against
+    a short sequence can never squeeze past the divisibility check as a
+    tile-violating remnant (the r05 bench regression: a raw min() clamp
+    produced blocks like 8/8 and the opaque "violate TPU tiling" reason).
+    Raises ValueError with the fallback reason when no valid tile exists —
+    the dispatcher's cue to take the XLA path."""
+    bq = _auto_block(sq, block_q or DEFAULT_BLOCK_Q, 8)
+    if bq is None:
+        raise ValueError(
+            f"Sq={sq} has no divisor aligned to the TPU sublane tile (8)"
+            + (f" at or under block_q={block_q}" if block_q else ""))
+    bk = _auto_block(sk, block_k or DEFAULT_BLOCK_K, 128)
+    if bk is None:
+        # block_k spans the LANE axis of the [block_q, block_k] score
+        # tile, so it needs 128-alignment (block_q only needs sublane 8).
+        raise ValueError(
+            f"Sk={sk} has no divisor aligned to the TPU lane tile (128)"
+            + (f" at or under block_k={block_k}" if block_k else ""))
+    return bq, bk
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
                   scale: float, causal: bool, block_q: int, block_k: int,
                   n_k_blocks: int, diag_offset: int):
@@ -109,37 +135,17 @@ def flash_attention(q, k, v, *, causal: bool = True,
                     block_k: int | None = None,
                     interpret: bool = False):
     """q: [B, Sq, Hq, D]; k/v: [B, Sk, Hkv, D] (GQA when Hq > Hkv).
-    Returns [B, Sq, Hq, D]. block_q/block_k default to lane-aligned sizes
-    auto-derived from Sq/Sk (largest aligned divisors up to the tuned
-    512/1024). Raises ValueError for unsupported shapes (the dispatcher
-    falls back to the XLA path and logs)."""
+    Returns [B, Sq, Hq, D]. block_q/block_k are upper-bound preferences;
+    the actual blocks are tile-aligned divisors of Sq/Sk derived by
+    derive_blocks (defaults: the tuned 512/1024). Raises ValueError for
+    shapes with no valid tiling (the dispatcher falls back to the XLA
+    path and logs)."""
     b, sq, hq, d = q.shape
     _, sk, hkv, _ = k.shape
     if hq % hkv != 0:
         raise ValueError(f"Hq={hq} not a multiple of Hkv={hkv}")
-    if block_q is None:
-        block_q = _auto_block(sq, DEFAULT_BLOCK_Q, 8)
-        if block_q is None:
-            raise ValueError(
-                f"Sq={sq} has no divisor aligned to the TPU sublane "
-                f"tile (8)")
-    if block_k is None:
-        # block_k spans the LANE axis of the [block_q, block_k] score
-        # tile, so it needs 128-alignment (block_q only needs sublane 8).
-        block_k = _auto_block(sk, DEFAULT_BLOCK_K, 128)
-        if block_k is None:
-            raise ValueError(
-                f"Sk={sk} has no divisor aligned to the TPU lane tile "
-                f"(128)")
-    block_q = min(block_q, sq)
-    block_k = min(block_k, sk)
-    if sq % block_q or sk % block_k:
-        raise ValueError(
-            f"Sq={sq}/Sk={sk} not divisible by blocks {block_q}/{block_k}")
-    if block_q % 8 or block_k % 128:
-        # TPU tiling: sublane multiples of 8, lane multiples of 128.
-        raise ValueError(
-            f"blocks {block_q}/{block_k} violate TPU tiling (8/128)")
+    block_q, block_k = derive_blocks(sq, sk, block_q, block_k)
+    assert not (sq % block_q or sk % block_k or block_q % 8 or block_k % 128)
     rep = hq // hkv
     scale = d ** -0.5
     n_q = sq // block_q

@@ -29,6 +29,7 @@ def _flush_loop():
 def _flush_now():
     from ray_tpu._private.worker import global_worker
 
+    _drain_task_dispatch()
     with _lock:
         global _pending
         if not _pending:
@@ -51,6 +52,47 @@ def _record(rec: dict):
             _flusher_started = True
             threading.Thread(target=_flush_loop, daemon=True,
                              name="rt-metrics-flush").start()
+
+
+# --- task dispatch route counters ------------------------------------------
+# Which path task submissions take: "direct" (owner-side leased dispatch,
+# the controller never sees the task) vs "controller" (classic central
+# dispatch: TPU tasks, RT_DIRECT_DISPATCH=0, direct-dispatch failover).
+# The hot path pays one lock+int per submission; the per-path Counter
+# records are minted once per flush interval from the accumulated deltas.
+_task_dispatch_lock = threading.Lock()
+_task_dispatch_counts = {"direct": 0, "controller": 0}
+_task_dispatch_totals = {"direct": 0, "controller": 0}
+
+
+def record_task_dispatch(path: str, n: int = 1) -> None:
+    """Count `n` task submissions routed via `path` ('direct' or
+    'controller'). Called from the submit hot paths — keep it cheap."""
+    global _flusher_started
+    with _task_dispatch_lock:
+        _task_dispatch_counts[path] = _task_dispatch_counts.get(path, 0) + n
+        _task_dispatch_totals[path] = _task_dispatch_totals.get(path, 0) + n
+    with _lock:
+        if not _flusher_started:
+            _flusher_started = True
+            threading.Thread(target=_flush_loop, daemon=True,
+                             name="rt-metrics-flush").start()
+
+
+def task_dispatch_counts() -> dict:
+    """Process-local lifetime totals per dispatch path (tests/diagnostics —
+    no controller round trip)."""
+    with _task_dispatch_lock:
+        return dict(_task_dispatch_totals)
+
+
+def _drain_task_dispatch() -> None:
+    with _task_dispatch_lock:
+        deltas = {p: v for p, v in _task_dispatch_counts.items() if v}
+        for p in deltas:
+            _task_dispatch_counts[p] = 0
+    for path, v in deltas.items():
+        TASKS_DISPATCHED.inc(v, tags={"path": path})
 
 
 class Metric:
@@ -117,3 +159,12 @@ class Histogram(Metric):
         _record({"kind": "histogram", "name": self._name,
                  "desc": self._description, "tags": self._tags(tags),
                  "value": float(value), "boundaries": self._boundaries})
+
+
+#: Tasks submitted per dispatch route (see record_task_dispatch): the
+#: direct-vs-controller split is THE health signal for owner-side dispatch —
+#: a rising "controller" share under RT_DIRECT_DISPATCH=1 means failovers.
+TASKS_DISPATCHED = Counter(
+    "rt_tasks_dispatched_total",
+    description="tasks submitted, by dispatch path",
+    tag_keys=("path",))

@@ -38,18 +38,21 @@ def test_engine_greedy_deterministic(engine):
 def test_engine_no_lockstep(engine):
     """Requests of different lengths complete independently — the defining
     property of continuous batching vs whole-batch generate()."""
+    # 120 tokens (30 decode chunks): wide enough that the consumer thread
+    # reliably observes the long request still active right after the short
+    # one drains, even when a loaded CI box deschedules it for a while.
     long_s = engine.submit([5, 6, 7], SamplingParams(temperature=0.0,
-                                                     max_tokens=60))
+                                                     max_tokens=120))
     time.sleep(0.05)
     t0 = time.monotonic()
     short = engine.submit([8, 9], SamplingParams(temperature=0.0,
                                                  max_tokens=3)).tokens()
     short_done = time.monotonic() - t0
-    assert len(short) == 3
     # the long request must still be in flight when the short one finished
     assert engine.num_active >= 1
+    assert len(short) == 3
     long_toks = long_s.tokens()
-    assert len(long_toks) == 60
+    assert len(long_toks) == 120
     assert short_done < 30.0
 
 

@@ -61,6 +61,42 @@ def test_flash_attention_accepts_bench_shape():
     assert out.shape == (b, s, h, d)
 
 
+def test_flash_attention_block_derivation_clamps_to_valid_tiles():
+    """derive_blocks is the single derivation path (auto AND explicit
+    preferences): the bench shape must land on the tuned 512/1024, explicit
+    oversized blocks clamp to aligned divisors instead of slipping through
+    min() as tile-violating remnants (r05: 'blocks 8/8 violate TPU
+    tiling'), and infeasible shapes raise the fallback reason."""
+    from ray_tpu.ops.flash_attention import derive_blocks
+
+    # The microbench shape (b4 s2048 h8 d128) selects the Pallas path with
+    # the tuned blocks.
+    assert derive_blocks(2048, 2048) == (512, 1024)
+    # Explicit blocks are preferences: clamped to aligned divisors.
+    assert derive_blocks(256, 256, 1024, 1024) == (256, 256)
+    assert derive_blocks(2048, 2048, 100, 1000) == (64, 512)
+    # A short k sequence can never produce a sub-128 block_k: it raises
+    # (XLA fallback) with the reason, not a tile-violating 8/8 pair.
+    with pytest.raises(ValueError, match="lane tile"):
+        derive_blocks(8, 8)
+    with pytest.raises(ValueError, match="lane tile"):
+        derive_blocks(256, 64, 128, 128)
+    with pytest.raises(ValueError, match="sublane tile"):
+        derive_blocks(100, 256)
+
+
+def test_flash_attention_explicit_blocks_clamped_numerics():
+    """An explicit block preference larger than the sequence still runs
+    (clamped), matching the XLA reference."""
+    rng = np.random.RandomState(7)
+    b, s, h, d = 1, 256, 2, 32
+    q = jnp.asarray(rng.randn(b, s, h, d), jnp.float32)
+    out = flash_attention(q, q, q, causal=True, block_q=512, block_k=1024,
+                          interpret=True)
+    ref = _xla_attention(q, q, q, causal=True)
+    assert float(jnp.max(jnp.abs(out - ref))) < 2e-5
+
+
 def test_flash_attention_auto_blocks():
     """Auto-derived blocks: lane-aligned divisors of Sq/Sk, numerics still
     matching the XLA reference; shapes with no aligned divisor raise."""

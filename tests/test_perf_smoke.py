@@ -27,3 +27,12 @@ def test_bench_smoke_runs():
     for k in ("multi_client_tasks_async", "n_n_actor_calls_async",
               "single_client_put_gigabytes"):
         assert rep["details"][k] > 0
+    # Direct dispatch must beat the controller path on the SAME
+    # multi-client workload (the tentpole's reason to exist). Margin is
+    # deliberately modest — this is a smoke guard, not a benchmark.
+    direct = rep["details"]["multi_client_tasks_async"]
+    ctrl = rep["details"].get("multi_client_tasks_async_controller_path")
+    assert ctrl and ctrl > 0, "controller-path comparison missing"
+    assert direct > 1.2 * ctrl, (
+        f"direct dispatch ({direct}/s) does not beat the controller path "
+        f"({ctrl}/s)")
