@@ -231,6 +231,9 @@ def main(smoke: bool = False):
         # the SAME multi-client workload with RT_DIRECT_DISPATCH=0 routes
         # every task through the controller — direct dispatch must beat it.
         _bench_ctrl_path_multi_client(extra_details)
+        # Device object plane A/B (perf-gate input): actor→actor 64MB
+        # jax.Array handoff, device plane vs RT_DEVICE_OBJECTS=0 host store.
+        _bench_device_object_p2p(extra_details)
 
     ratios = {k: results[k] / BASELINES[k] for k in BASELINES if k in results}
     # put-GB/s is bounded by this host's memcpy bandwidth (one mandatory
@@ -304,6 +307,85 @@ def _bench_ctrl_path_multi_client(details: dict):
             ray_tpu.shutdown()
         except Exception:
             pass
+
+
+def _bench_device_object_p2p(details: dict):
+    """Actor→actor handoff of a 64MB jax.Array: producer.make() -> ref ->
+    consumer.consume(ref), timed end to end, with the device object plane
+    ON vs OFF (RT_DEVICE_OBJECTS=0 = today's host-store path). The device
+    plane skips the producer-side host materialization the host path pays
+    at return time (jax.Array pickling copies device bytes to host before
+    the shm write) — the A/B is the perf gate's proof the plane earns its
+    keep (tests/test_perf_smoke.py asserts device >= 1.5x host)."""
+    import ray_tpu
+
+    mb = 64
+    n = (mb << 20) // 4  # float32 elements
+
+    def run_once(plane_on: bool) -> float:
+        prev = os.environ.get("RT_DEVICE_OBJECTS")
+        # Force BOTH legs (ambient RT_DEVICE_OBJECTS=0 must not silently
+        # turn the A into a second B and fail the gate at ~1.0x).
+        os.environ["RT_DEVICE_OBJECTS"] = "1" if plane_on else "0"
+        try:
+            ray_tpu.init(num_cpus=4)
+
+            @ray_tpu.remote(num_cpus=0)
+            class Producer:
+                def __init__(self):
+                    self._arr = None
+
+                def make(self, i):
+                    # Hand off an EXISTING device-resident array (the
+                    # steady-state train/llm shape: weights/activations
+                    # already live on device) — production cost would
+                    # dilute the transfer A/B identically on both sides.
+                    import jax.numpy as jnp
+
+                    if self._arr is None:
+                        self._arr = jnp.full((n,), 7.0, jnp.float32)
+                        self._arr.block_until_ready()
+                    return self._arr
+
+            @ray_tpu.remote(num_cpus=0)
+            class Consumer:
+                def consume(self, a):
+                    return int(a.nbytes)  # array fully materialized at decode
+
+            p, c = Producer.remote(), Consumer.remote()
+
+            def handoff(i):
+                assert ray_tpu.get(c.consume.remote(p.make.remote(i)),
+                                   timeout=120) == mb << 20
+
+            handoff(0)  # warm both processes (jax import, pools)
+            iters = 0
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < max(MIN_TIME, 1.0):
+                iters += 1
+                handoff(iters)
+            dt = time.perf_counter() - t0
+            return iters * (mb << 20) / 1e9 / dt
+        finally:
+            if prev is None:
+                os.environ.pop("RT_DEVICE_OBJECTS", None)
+            else:
+                os.environ["RT_DEVICE_OBJECTS"] = prev
+            try:
+                ray_tpu.shutdown()
+            except Exception:
+                pass
+
+    try:
+        dev = run_once(plane_on=True)
+        host = run_once(plane_on=False)
+    except Exception as e:
+        log(f"  device_object_p2p skipped: {e}")
+        return
+    log(f"  device_object_p2p: device {dev:.2f} GB/s vs host store "
+        f"{host:.2f} GB/s ({dev / max(host, 1e-9):.2f}x)")
+    details["device_object_p2p_gbps"] = round(dev, 2)
+    details["device_object_p2p_host_gbps"] = round(host, 2)
 
 
 # ---- compiled-graph channel round-trip (native futex ring) ---------------

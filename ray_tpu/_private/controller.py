@@ -35,7 +35,8 @@ logger = logging.getLogger(__name__)
 
 class _ObjectEntry:
     __slots__ = ("state", "inline", "holders", "size", "waiters", "owner",
-                 "error", "escaped", "borrowers", "dying_at")
+                 "error", "escaped", "borrowers", "dying_at", "plane",
+                 "device_worker", "device_node")
 
     def __init__(self):
         self.state = "pending"  # pending | ready | lost
@@ -45,6 +46,13 @@ class _ObjectEntry:
         self.waiters: list[asyncio.Future] = []
         self.owner: Optional[str] = None
         self.error = None  # serialized error blob (parts) shared with owner
+        # Device object plane (README "Device objects"): "device" entries
+        # hold only a placeholder inline; the payload is pinned in the
+        # producing worker's DeviceObjectTable. device_worker/device_node
+        # drive the free fan-out and the producer-death lost sweep.
+        self.plane: Optional[str] = None  # None/"host" | "device"
+        self.device_worker: Optional[str] = None
+        self.device_node: Optional[str] = None
         # Borrower protocol (reference reference_count.h:72): an oid that
         # ESCAPED its owner (was serialized into a payload another process
         # can see) is not freed when the owner's refcount hits zero — it is
@@ -98,6 +106,11 @@ class Controller:
         self.node_conns: dict[str, rpc.Connection] = {}
         self.client_conns: dict[str, rpc.Connection] = {}  # worker_id -> conn
         self.objects: dict[str, _ObjectEntry] = {}
+        # Device-plane directory index: producer worker id -> ready device
+        # oids. Keeps the per-death lost sweep O(that worker's entries)
+        # instead of a full object-table scan per worker exit (and exactly
+        # zero for clusters that never touch the plane).
+        self._device_index: dict[str, set] = {}
         # oid -> expiry: freed refs whose late advertises must not
         # resurrect directory entries (see _p_free_objects)
         self.freed_tombstones: dict[str, float] = {}
@@ -397,6 +410,23 @@ class Controller:
             asyncio.ensure_future(
                 self._reap_owned_actors(wid, conn.meta.get("mode")))
             asyncio.ensure_future(self._reap_borrows(wid))
+            asyncio.ensure_future(self._client_device_sweep(wid))
+
+    async def _client_device_sweep(self, wid: str):
+        """A client (driver or worker) connection closed: after a short
+        grace (the close may be a transient drop — reconnects re-register
+        on a fresh conn), device entries the process produced go LOST so
+        consumers get the fast sticky ObjectLostError instead of a connect
+        timeout per read. Worker processes are also covered by the agent's
+        worker_died report; this path is the only one that reaches DRIVER
+        producers."""
+        if not self._device_index.get(wid):
+            return
+        await asyncio.sleep(max(1.0, CONFIG.node_suspect_grace_s))
+        conn = self.client_conns.get(wid)
+        if conn is not None and not conn.closed:
+            return  # re-registered: the producer (and its pins) live on
+        await self._device_objects_lost(wid, "process disconnected")
 
     async def _reconcile_reported_worker(self, nid: str, node: "NodeState", w: dict):
         """One inventory entry from a re-registering agent (controller
@@ -1476,12 +1506,22 @@ class Controller:
     # ------------------------------------------------------------- objects
     async def _h_register_put(self, conn, a):
         if self._freed(a["oid"]):
-            await self._purge_late(a["oid"], a.get("holder"))
+            await self._purge_late(
+                a["oid"], a.get("holder"),
+                device_worker=(a.get("device_worker")
+                               if a.get("plane") == "device" else None))
             return {}
         ent = self.objects.setdefault(a["oid"], _ObjectEntry())
         ent.state = "ready"
         ent.owner = a.get("owner") or conn.meta.get("worker_id")
         ent.size = a["size"]
+        if a.get("plane"):
+            ent.plane = a["plane"]
+            ent.device_worker = a.get("device_worker")
+            ent.device_node = a.get("device_node")
+            if ent.device_worker:
+                self._device_index.setdefault(
+                    ent.device_worker, set()).add(a["oid"])
         if a.get("inline") is not None:
             ent.inline = a["inline"]
         if a.get("holder") is not None:
@@ -1706,6 +1746,7 @@ class Controller:
             out.append({"object_id": oid, "state": ent.state,
                         "size": ent.size, "owner": ent.owner,
                         "inline": ent.inline is not None,
+                        "plane": ent.plane or "host",
                         "holders": [list(h) for h in ent.holders]})
             if len(out) >= limit:
                 break
@@ -1809,6 +1850,7 @@ class Controller:
             self.freed_tombstones = {
                 o: t for o, t in self.freed_tombstones.items() if t > now}
         shm_oids = []
+        device_frees: dict[str, list] = {}  # producer worker_id -> oids
         for oid in oids:
             ent = self.objects.get(oid)
             if oid in escaped or (ent is not None and ent.escaped):
@@ -1822,19 +1864,43 @@ class Controller:
             # task finishing after the tombstone expires would resurrect the
             # entry (and pin its shm segment forever).
             self.freed_tombstones[oid] = now + 600.0
-            if ent is not None and ent.inline is None and ent.holders:
+            if ent is not None and ent.plane == "device":
+                # Device-plane entry: the payload is pinned in the producing
+                # process — unpin it with a TARGETED device_free on that
+                # producer's own client connection (works for driver
+                # producers too, which no agent can reach), and purge the
+                # shm export names everywhere like any other segment.
+                if ent.device_worker:
+                    device_frees.setdefault(ent.device_worker, []).append(oid)
+                self._device_index_drop(ent, oid)
+                shm_oids.append(oid)
+            elif ent is not None and ent.inline is None and ent.holders:
                 shm_oids.append(oid)
         if len(self.freed_tombstones) > 200_000:  # hard cap, oldest first
             for o in list(self.freed_tombstones)[:100_000]:
                 self.freed_tombstones.pop(o, None)
         if shm_oids:
             await self._purge_on_agents(shm_oids)
+        await self._push_device_frees(device_frees)
 
     async def _purge_on_agents(self, shm_oids: list[str]):
         for nconn in self.node_conns.values():
             if not nconn.closed:
                 try:
                     await nconn.push("free", oids=shm_oids)
+                except Exception:
+                    pass
+
+    async def _push_device_frees(self, by_worker: dict):
+        """Unpin freed device objects at their producers: ONE device_free
+        push per producing process over its registered client connection
+        (executing workers and drivers both register as clients) — not a
+        cluster-wide broadcast."""
+        for worker_id, oids in by_worker.items():
+            conn = self.client_conns.get(worker_id)
+            if conn is not None and not conn.closed:
+                try:
+                    await conn.push("device_free", oids=oids)
                 except Exception:
                     pass
 
@@ -1863,13 +1929,20 @@ class Controller:
     async def _free_escaped(self, oids: list[str]):
         now = time.monotonic()
         shm_oids = []
+        device_frees: dict[str, list] = {}
         for oid in oids:
             ent = self.objects.pop(oid, None)
             self.freed_tombstones[oid] = now + 600.0
-            if ent is not None and ent.inline is None and ent.holders:
+            if ent is not None and ent.plane == "device":
+                if ent.device_worker:
+                    device_frees.setdefault(ent.device_worker, []).append(oid)
+                self._device_index_drop(ent, oid)
+                shm_oids.append(oid)
+            elif ent is not None and ent.inline is None and ent.holders:
                 shm_oids.append(oid)
         if shm_oids:
             await self._purge_on_agents(shm_oids)
+        await self._push_device_frees(device_frees)
 
     async def _sweep_dying(self):
         """Reap owner-freed escaped entries whose grace TTL expired with no
@@ -1890,10 +1963,15 @@ class Controller:
             return False
         return True
 
-    async def _purge_late(self, oid: str, holder):
+    async def _purge_late(self, oid: str, holder,
+                          device_worker: str | None = None):
         """A result advertised after its ref was freed: purge the shm names
-        it just created (fire-and-forget tasks with large returns)."""
-        if holder is None:
+        it just created (fire-and-forget tasks with large returns). A late
+        DEVICE advertise also unpins at the producer — otherwise the pin
+        (and the device memory under it) would outlive the freed ref."""
+        if device_worker:
+            await self._push_device_frees({device_worker: [oid]})
+        if holder is None and not device_worker:
             return
         for nconn in self.node_conns.values():
             if not nconn.closed:
@@ -2080,7 +2158,50 @@ class Controller:
             if ent.name:
                 self.named_actors.pop((ent.namespace, ent.name), None)
 
-    async def _actor_worker_died(self, actor_id: str, reason: str, worker_id: str | None = None):
+    def _device_index_drop(self, ent, oid: str) -> None:
+        if ent.device_worker:
+            s = self._device_index.get(ent.device_worker)
+            if s is not None:
+                s.discard(oid)
+                if not s:
+                    self._device_index.pop(ent.device_worker, None)
+
+    async def _mark_device_lost(self, oid: str, ent, message: str):
+        """One device entry's payload died with its producer: flip the
+        entry to lost and tell the owner, so a consumer's get() surfaces a
+        clean ObjectLostError NAMING the lost producer instead of hanging
+        on a dead address."""
+        ent.state = "lost"
+        ent.inline = None
+        ent.wake()
+        self._device_index_drop(ent, oid)
+        oconn = self.client_conns.get(ent.owner)
+        if oconn is not None and not oconn.closed:
+            try:
+                await oconn.push("object_lost", oid=oid, message=message)
+            except Exception:
+                pass
+
+    async def _device_objects_lost(self, worker_id: str, why: str):
+        """A worker process died taking its DeviceObjectTable with it.
+        Idempotent: already-lost entries are skipped. O(that worker's
+        entries) via the device index — routine worker exits on clusters
+        that never touch the plane cost nothing."""
+        oids = self._device_index.pop(worker_id, None)
+        if not oids:
+            return
+        for oid in oids:
+            ent = self.objects.get(oid)
+            if ent is None or ent.plane != "device" or ent.state != "ready":
+                continue
+            await self._mark_device_lost(
+                oid, ent,
+                f"device object {oid[:16]} lost: producing worker "
+                f"{worker_id[:12]} {why}")
+
+    async def _actor_worker_died(self, actor_id: str, reason: str,
+                                 worker_id: str | None = None,
+                                 device_swept: bool = False):
         """Process the death of one actor *instance*. Idempotent: each
         instance's death is consumed exactly once (keyed by the instance's
         worker_id), so a kill() followed by the agent's worker_died report
@@ -2095,6 +2216,12 @@ class Controller:
                 return  # stale report for an already-handled instance
         elif ent.state == "RESTARTING":
             return  # death already being handled; a restart is in flight
+        # Device objects pinned in this instance die with it (kill() skips
+        # the agent's worker_died report, so this is the kill path's sweep;
+        # _p_worker_died already swept when it is the caller).
+        wid = worker_id or ent.worker_id
+        if wid and not device_swept:
+            await self._device_objects_lost(wid, f"died ({reason})")
         # Drop any in-flight creation bookkeeping.
         self.dispatched.pop(ent.spec.task_id, None)
         self._release_actor_resources(ent)
@@ -2110,13 +2237,15 @@ class Controller:
             return  # stale-incarnation zombie: must not kill current state
         cause = a.get("cause")
         if a.get("worker_id"):
+            await self._device_objects_lost(a["worker_id"], "process died")
             await self._lease_worker_died(a["worker_id"], cause=cause)
         actor_id = a.get("actor_id")
         task_id = a.get("task_id")
         if actor_id:
             await self._actor_worker_died(
                 actor_id, f"worker process died: {a.get('reason', '')}",
-                worker_id=a.get("worker_id"))
+                worker_id=a.get("worker_id"),
+                device_swept=bool(a.get("worker_id")))
         if task_id:
             info = self.dispatched.pop(task_id, None)
             if info is not None:
@@ -2304,6 +2433,17 @@ class Controller:
         # reconstruct from lineage (reference object_recovery_manager.cc:26).
         dead_addr = node.address
         for oid, ent in list(self.objects.items()):  # handlers may insert during awaits
+            if ent.plane == "device":
+                # Device entries hold only a placeholder inline; the payload
+                # lived in a worker on the node. Every producer there died
+                # with it.
+                if ent.device_node == nid and ent.state == "ready":
+                    await self._mark_device_lost(
+                        oid, ent,
+                        f"device object {oid[:16]} lost: producing worker "
+                        f"{(ent.device_worker or '?')[:12]} died with node "
+                        f"{nid[:8]}")
+                continue
             if ent.state != "ready" or ent.inline is not None:
                 continue
             ent.holders = {h for h in ent.holders if tuple(h) != tuple(dead_addr)}

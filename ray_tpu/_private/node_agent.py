@@ -31,7 +31,7 @@ logger = logging.getLogger(__name__)
 class _WorkerSlot:
     __slots__ = ("worker_id", "proc", "conn", "state", "task_id", "actor_id", "address",
                  "registered", "dedicated", "idle_since", "assigned_at",
-                 "held_resources")
+                 "held_resources", "device_pinned")
 
     def __init__(self, worker_id: str, proc, dedicated: bool = False):
         self.worker_id = worker_id
@@ -49,6 +49,10 @@ class _WorkerSlot:
         # re-registration so a RESTARTED controller can rebuild accounting
         # (reference RayletNotifyGCSRestart reconciliation).
         self.held_resources: Optional[dict] = None
+        # True while the worker reports live DeviceObjectTable pins: an
+        # idle pool worker is still the STORAGE for those objects, so the
+        # idle reaper must not kill it (README "Device objects").
+        self.device_pinned = False
 
 
 class NodeAgent:
@@ -445,6 +449,9 @@ class NodeAgent:
 
     async def _on_ctrl_push(self, conn, method, a):
         if method == "free":
+            # Covers device-object EXPORT segments too; the pin itself is
+            # unpinned by the controller's targeted device_free push on the
+            # producer's own client connection.
             for oid in a["oids"]:
                 self.store.purge(oid)
         elif method == "kill_worker":
@@ -544,6 +551,10 @@ class NodeAgent:
                        retryable=a.get("retryable", False),
                        expires=time.monotonic() + 600.0)
             rec["event"].set()
+        elif method == "device_pins":
+            slot = self.workers.get(a["worker_id"])
+            if slot is not None:
+                slot.device_pinned = bool(a.get("pinned"))
 
     def _on_worker_conn_close(self, conn):
         wid = conn.meta.get("worker_id")
@@ -778,12 +789,36 @@ class NodeAgent:
                         self._direct_tasks.pop(tid, None)
             keep = CONFIG.idle_worker_keep_s
             if keep > 0:
-                idle = [s for s in self.workers.values() if s.state == "idle" and not s.dedicated]
+                # Workers still pinning device objects are the storage for
+                # those objects — exempt from the idle reap until the
+                # owner-tracked frees drain their table.
+                idle = [s for s in self.workers.values()
+                        if s.state == "idle" and not s.dedicated
+                        and not s.device_pinned]
                 now = time.monotonic()
                 warm = 1 if CONFIG.prestart_workers else 0
                 for slot in sorted(idle, key=lambda s: s.idle_since)[: max(0, len(idle) - warm)]:
                     if now - slot.idle_since > keep:
+                        # Kill FIRST (atomic with the idle check — no await
+                        # between them, so a lease/dispatch cannot claim the
+                        # slot mid-reap), then report. The kill path skips
+                        # the worker_died report (_worker_exited sees
+                        # state=="dead"), but a pin could have landed since
+                        # the last device_pins report: tell the controller
+                        # so any device entries it produced go cleanly LOST
+                        # instead of pointing at a dead address forever.
+                        # Plane off => no pins possible, reap stays silent.
                         self._kill_slot(slot)
+                        if CONFIG.device_objects:
+                            try:
+                                await self.controller.push(
+                                    "worker_died", worker_id=slot.worker_id,
+                                    task_id=None, actor_id=None,
+                                    reason="idle worker reaped", cause=None,
+                                    node_id=self.node_id,
+                                    incarnation=self.incarnation)
+                            except Exception:
+                                pass
 
     async def _worker_exited(self, slot: _WorkerSlot, reason: str,
                              cause: str | None = None):

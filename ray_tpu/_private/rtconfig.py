@@ -101,6 +101,16 @@ _flag("lease_idle_s", float, 0.5)
 # _generator_backpressure_num_objects); <=0 disables backpressure.
 _flag("generator_backpressure_items", int, 64)
 _flag("log_to_driver", bool, True)
+# Device object plane (README "Device objects"): single-device jax.Arrays
+# returned from tasks/actors (or put()) stay pinned in the producing
+# process's DeviceObjectTable behind a placeholder ObjectRef instead of
+# being copied through the host store; resolution is tiered (in-process
+# zero-copy / same-host shm export / cross-host streamed fetch). False
+# restores the host-store path everywhere, byte-identically.
+_flag("device_objects", bool, True)
+# Arrays below this ride the host path (inline) as before — pinning tiny
+# arrays costs more bookkeeping than the copy it saves.
+_flag("device_object_min_bytes", int, 100 * 1024)
 # RPC write coalescing (see README "Transport"): frames buffer per
 # connection and flush with ONE drain per event-loop burst. rpc_coalesce
 # False restores the legacy one-drain-per-frame path; wbuf_high_bytes is

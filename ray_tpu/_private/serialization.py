@@ -100,6 +100,16 @@ class SerializedObject:
         return SerializedObject(header=header, buffers=buffers, contained_refs=ref_oids)
 
 
+def inline_header_blob(header: bytes) -> bytes:
+    """Wrap a bare pickle-5 header in the standard inline wire layout
+    ([nrefs=0][nbufs=0][hlen][header], the to_bytes() tiny-result shape).
+    Used to inline DEVICE-REF PLACEHOLDERS (_private/device_store._DeviceRef)
+    in args/returns: the placeholder rides every existing blob path —
+    including the no-refs/no-bufs fast deserialize — and unpickling it
+    resolves the array through the device plane's tier ladder."""
+    return struct.pack("<IIQ", 0, 0, len(header)) + header
+
+
 class _RefPlaceholder:
     __slots__ = ("index",)
 

@@ -19,6 +19,13 @@ ACTOR_TASK = "actor_task"
 #: num_returns="streaming" -> ObjectRefGenerator).
 STREAMING = "streaming"
 
+#: Arg wire-encoding tag for device-plane arrays: ("dref", oid,
+#: placeholder_blob). The placeholder (see _private/device_store) carries
+#: the producer's device-location hint INSIDE the spec, so the executor
+#: resolves it peer-to-peer with no controller round trip — the device
+#: edition of the ("ref", oid) encoding below.
+DEVICE_REF = "dref"
+
 
 @dataclass
 class SchedulingStrategy:
@@ -218,7 +225,10 @@ class TaskSpec:
     def ref_arg_oids(self) -> list[str]:
         """Oids of by-reference arguments — the single place that knows the
         ('ref', oid) arg wire encoding (used by locality scheduling and
-        executor-side prefetch)."""
+        executor-side prefetch). DEVICE_REF ('dref') args are deliberately
+        excluded: their placeholder already names the producer, so a
+        controller-backed prefetch/locality probe would be a wasted round
+        trip — resolution pulls peer-to-peer at decode time."""
         out = []
         for a in self.args or ():
             if isinstance(a, (tuple, list)) and a and a[0] == "ref":

@@ -28,7 +28,7 @@ from concurrent.futures import TimeoutError as _FuturesTimeout
 from typing import Any, Optional
 
 from ray_tpu import exceptions as exc
-from ray_tpu._private import rpc
+from ray_tpu._private import device_store, rpc
 from ray_tpu._private.ids import ObjectID, TaskID, WorkerID
 from ray_tpu._private.lease import LeaseManager, _record_dispatch
 from ray_tpu._private.object_store import LocalStore
@@ -44,6 +44,7 @@ from ray_tpu._private.serialization import (
 from ray_tpu._private.task_spec import (
     ACTOR_CREATE,
     ACTOR_TASK,
+    DEVICE_REF,
     NORMAL,
     STREAMING,
     SchedulingStrategy,
@@ -405,6 +406,12 @@ class Worker:
         self._resolutions: dict[str, _Resolution] = {}
         self._inline_cache: dict[str, list] = {}  # oid -> blob parts (small objs)
         self._lineage: dict[str, TaskSpec] = {}  # return oid -> producing spec
+        # Device-ref ARG pins: first-return oid -> dref arg oids whose
+        # submit-time hold is dropped when that return ref is freed (the
+        # args must outlive the result ref — lineage reconstruction re-runs
+        # the spec and re-resolves them — but no longer: holding device
+        # memory for the session per distinct array argument would leak).
+        self._arg_pins: dict[str, tuple] = {}
         self._registered_fns: set[str] = set()
         self._fn_cache: dict[str, Any] = {}
         import weakref
@@ -496,6 +503,10 @@ class Worker:
         except Exception:
             pass
         self.io.stop()
+        try:
+            device_store.on_worker_shutdown()
+        except Exception:
+            pass
         self.store.shutdown()
         if global_worker() is self:
             set_global_worker(None)
@@ -590,6 +601,14 @@ class Worker:
             # rides the wire; the fetcher reassembles into its own segment.
             return {"found": True, "size": len(mv),
                     "data": mv[off : off + a["length"]]}
+        if method == "export_device_object":
+            # Device object plane tier-1/2 serving side: materialize the
+            # pinned array's bytes into the local shm store (one host copy,
+            # off the IO loop — a 64MB export must not stall frame
+            # processing) so the consumer can attach or stream-fetch.
+            found = await asyncio.to_thread(
+                device_store.export_to_store, a["oid"], self.store)
+            return {"found": bool(found)}
         if method == "health":
             return {"ok": True}
         if method == "whoami":
@@ -657,6 +676,11 @@ class Worker:
                     cb(a["channel"], a["payload"])
                 except Exception:
                     pass
+        elif method == "device_free":
+            # Targeted unpin from the controller: the last reference to
+            # device objects THIS process produced died (README "Device
+            # objects" ownership). Export segments go with the pin.
+            device_store.free_local(a["oids"], self.store)
         elif method == "lease_invalid":
             self.lease_mgr.on_lease_invalid(a["lease_id"], cause=a.get("cause"))
         elif method == "need_resources":
@@ -682,8 +706,9 @@ class Worker:
             oid = a["oid"]
             self._ctrl_resolved.add(oid)
             if not self._maybe_reconstruct_async(oid):
+                msg = a.get("message") or f"object {oid[:16]} lost (node died)"
                 h, bufs = dumps_oob({"type": "ObjectLostError",
-                                     "message": f"object {oid[:16]} lost (node died)"})
+                                     "message": msg})
                 res = self._resolutions.setdefault(oid, _Resolution())
                 res.resolve(None, [], [h, *bufs])
 
@@ -750,7 +775,14 @@ class Worker:
     def _free(self, oids: list[str]):
         remote: list[str] = []
         escaped_oids: list[str] = []
+        released_args: list[str] = []
         for oid in oids:
+            pins = self._arg_pins.pop(oid, None)
+            if pins:
+                # Result ref died: its task's device-arg pins die with it
+                # (decref'd after the loop — a drop to zero re-enters
+                # _free for the arg oid).
+                released_args.extend(pins)
             self._inline_cache.pop(oid, None)
             escaped = oid in self._escaped
             ctrl = oid in self._ctrl_resolved
@@ -773,8 +805,18 @@ class Worker:
                 escaped_oids.append(oid)
                 remote.append(oid)
                 continue
-            res = self._resolutions.pop(oid, None)
+            res = self._resolutions.get(oid)
             self._lineage.pop(oid, None)
+            if (res is not None and not res.done and res.add_watcher(
+                    lambda o=oid: self._resolutions.pop(o, None))):
+                # Freed BEFORE the producing task completed (fire-and-forget
+                # result ref dropped immediately): the reply must still
+                # resolve THIS resolution object — completion watchers
+                # (device-arg unpins, escape advertises) hang off it — so
+                # keep it in the map until resolve pops it.
+                res = None
+            else:
+                self._resolutions.pop(oid, None)
             # Purely-local object: resolved from a direct (lease/actor-pipe)
             # reply inline, never escaped this process, controller never
             # heard of it — its free is a no-op everywhere else, so don't
@@ -783,8 +825,18 @@ class Worker:
             if (not ctrl and res is not None and res.done
                     and not res.holders):
                 continue
+            # Device-plane pin produced by THIS process (driver put / dref
+            # arg): drop it now rather than waiting for the controller's
+            # device_free round trip. Escaped device oids skipped above
+            # keep their pin while borrowers may still fetch (the grace
+            # sweep's targeted device_free lands here via _on_ctrl_push).
+            # has_pins() keeps the common host-path free at zero extra cost.
+            if device_store.has_pins():
+                device_store.free_local([oid])
             self.store.delete(oid)
             remote.append(oid)
+        for o in released_args:
+            self._decref(o)
         if not remote:
             return
         oids = remote
@@ -822,6 +874,9 @@ class Worker:
     def put(self, value) -> ObjectRef:
         if isinstance(value, ObjectRef):
             raise TypeError("Calling put() on an ObjectRef is not allowed.")
+        if device_store.eligible(value):
+            oid, _ = self._put_device(value)
+            return ObjectRef(oid, owned=True, worker=self)
         oid = ObjectID.from_put().hex()
         sobj = serialize(value, ref_class=ObjectRef)
         if sobj.contained_refs:  # refs escape into the putted payload
@@ -858,6 +913,21 @@ class Worker:
                     holder=holder, owner=self.worker_id)
         res = self._resolutions.setdefault(oid, _Resolution())
         res.resolve(None, [self.server_addr], None)
+
+    def _put_device(self, value) -> tuple[str, bytes]:
+        """Device-plane put: pin the live array in this process's
+        DeviceObjectTable and register only the placeholder with the
+        controller (same fire-and-forget ordering argument as _store_blob).
+        Returns (oid, placeholder_blob)."""
+        oid = ObjectID.from_put().hex()
+        blob, nbytes = device_store.pin_put(oid, value, self)
+        self.controller.push_threadsafe(
+            "register_put", oid=oid, size=nbytes, inline=[blob],
+            holder=self.server_addr, owner=self.worker_id,
+            **device_store.advert_fields(self.worker_id, self.node_id))
+        res = self._resolutions.setdefault(oid, _Resolution())
+        res.resolve([blob], [self.server_addr], None)
+        return oid, blob
 
     # ----------------------------------------------------------------- get
     def get(self, refs: list[ObjectRef], timeout: float | None = None) -> list:
@@ -917,6 +987,14 @@ class Worker:
                 # re-materialize from their resolution (step 1 of _get_one
                 # never consults the cache), so the write was pure churn.
                 self._inline_cache[oid] = [blob]
+            if deadline is not None:
+                # Device-ref placeholders do network work INSIDE the
+                # deserialize — bound it by the caller's get() deadline.
+                device_store.set_resolve_deadline(deadline)
+                try:
+                    return self._deserialize_blob(memoryview(blob))
+                finally:
+                    device_store.set_resolve_deadline(None)
             return self._deserialize_blob(memoryview(blob))
         val, found = self._try_local(oid)
         if found:
@@ -1438,24 +1516,42 @@ class Worker:
         return fn
 
     def _encode_args(self, args, kwargs):
-        """Returns (enc_args, enc_kwargs, escaping_oids). escaping_oids are
-        the refs shipped inside this payload — the submitter must PIN the
-        owned ones until the task completes (reference: task arguments hold
-        references, reference_count.h AddLocalReference for args), or
-        rebinding the Python variable frees the arg before the worker can
-        read it."""
+        """Returns (enc_args, enc_kwargs, escaping_oids, dref_oids).
+        escaping_oids are the refs shipped inside this payload — the
+        submitter must PIN the owned ones until the task completes
+        (reference: task arguments hold references, reference_count.h
+        AddLocalReference for args), or rebinding the Python variable frees
+        the arg before the worker can read it. dref_oids are device-plane
+        arg promotions, holding one refcount from _encode_one that the
+        submit path must tie to the task's return ref (_register_arg_pins)
+        or the pinned device memory outlives every reference to it."""
         escapes: list[str] = []
-        enc_args = [self._encode_one(a, escapes) for a in args]
-        enc_kwargs = {k: self._encode_one(v, escapes) for k, v in kwargs.items()}
-        return enc_args, enc_kwargs, escapes
+        drefs: list[str] = []
+        enc_args = [self._encode_one(a, escapes, drefs) for a in args]
+        enc_kwargs = {k: self._encode_one(v, escapes, drefs)
+                      for k, v in kwargs.items()}
+        return enc_args, enc_kwargs, escapes, drefs
 
-    def _encode_one(self, value, escapes: list | None = None):
+    def _encode_one(self, value, escapes: list | None = None,
+                    drefs: list | None = None):
         if isinstance(value, ObjectRef):
             oid = value.hex()
             self._advertise_escaping([oid])
             if escapes is not None:
                 escapes.append(oid)
             return ("ref", oid)
+        if device_store.eligible(value):
+            # Large device-array argument: pin instead of copying through
+            # the host store; the placeholder blob rides INSIDE the spec
+            # (task_spec.DEVICE_REF) so the executor resolves it from the
+            # location hint with no controller round trip. The incref is
+            # the submit-time hold; _register_arg_pins drops it when the
+            # task's return ref dies.
+            oid, blob = self._put_device(value)
+            self._incref(oid)
+            if drefs is not None:
+                drefs.append(oid)
+            return (DEVICE_REF, oid, blob)
         sobj = serialize(value, ref_class=ObjectRef)
         if sobj.contained_refs:
             oids = [r.hex() if isinstance(r, ObjectRef) else r
@@ -1493,6 +1589,26 @@ class Worker:
 
         if not res.add_watcher(_unpin):
             _unpin()  # already resolved
+
+    def _register_arg_pins(self, drefs: list[str], refs: list):
+        """Tie device-arg pins to the task's return refs: one hold per
+        return ref (the _encode_one incref covers the first; extras are
+        taken here), dropped as each ref is freed — so the pins outlive
+        any window where ANY result could still be lineage-reconstructed
+        (reconstruction re-runs the spec, which re-resolves the dref blobs
+        from this table), without holding device memory for the whole
+        session. No refs (fire-and-forget num_returns=0) keeps the session
+        hold — nothing observable ever says the task is done."""
+        if not drefs or not refs:
+            return
+        for i, r in enumerate(refs):
+            if i > 0:
+                for o in drefs:
+                    self._incref(o)
+            key = r.hex()
+            prev = self._arg_pins.get(key)
+            self._arg_pins[key] = ((tuple(prev) + tuple(drefs)) if prev
+                                   else tuple(drefs))
 
     def _advertise_escaping(self, oids: list[str]):
         """Owner-side escape analysis at the serialization boundary: a ref
@@ -1535,6 +1651,11 @@ class Worker:
         kind = e[0]
         if kind == "ref":
             return self._get_one(ObjectRef(e[1]), deadline=None)
+        if kind == DEVICE_REF:
+            # Device-plane argument: the placeholder carries its own
+            # location hint — deserializing resolves through the tier
+            # ladder directly (no wait_object round trip).
+            return self._deserialize_blob(memoryview(e[2]))
         return self._deserialize_blob(memoryview(e[1]))
 
     def submit_task(self, fn, args, kwargs, *, name=None, num_returns=1, resources: ResourceSet,
@@ -1551,8 +1672,9 @@ class Worker:
 
             runtime_env = _rtenv.package(self, runtime_env)
         fid = self._register_function(fn)
-        enc_args, enc_kwargs, escapes = (self._encode_args(args, kwargs)
-                                         if (args or kwargs) else ([], {}, []))
+        enc_args, enc_kwargs, escapes, drefs = (
+            self._encode_args(args, kwargs)
+            if (args or kwargs) else ([], {}, [], []))
         task_id = TaskID.from_random().hex()
         spec = TaskSpec(
             task_id=task_id,
@@ -1578,7 +1700,12 @@ class Worker:
             if spec.max_retries != 0 and not streaming:
                 self._lineage[oid] = spec
             refs.append(ObjectRef(oid, owned=True, worker=self))
-        self._pin_args_until_done(escapes, refs)
+        # drefs ride the until-done pin too: a fire-and-forget caller drops
+        # the result ref instantly, and without the completion hold the
+        # per-ref release would free the pinned arg before the executor
+        # decodes it (the host path gets this from the same call).
+        self._pin_args_until_done(escapes + drefs, refs)
+        self._register_arg_pins(drefs, refs)
         if streaming:
             # Streaming always rides the direct path (the controller
             # transport has no item stream), RT_DIRECT_DISPATCH or not.
@@ -1669,10 +1796,12 @@ class Worker:
 
             runtime_env = _rtenv.package(self, runtime_env)
         fid = self._register_function(cls)
-        enc_args, enc_kwargs, escapes = self._encode_args(args, kwargs)
+        enc_args, enc_kwargs, escapes, _drefs = self._encode_args(args, kwargs)
         # Actor init args must survive RESTARTS (the controller re-runs
         # __init__ from the same spec), so owned arg refs stay pinned for
-        # the session (reference: the GCS holds actor creation specs).
+        # the session (reference: the GCS holds actor creation specs) —
+        # device-arg pins (_drefs) keep their session hold for the same
+        # reason: a restart re-resolves them from the submitter's table.
         for o in escapes:
             if o in self._refcounts:
                 self._incref(o)
@@ -1721,8 +1850,9 @@ class Worker:
 
     def submit_actor_task(self, actor_id: str, method_name: str, args, kwargs, *,
                           num_returns=1, name=None, max_task_retries=0) -> list[ObjectRef]:
-        enc_args, enc_kwargs, escapes = (self._encode_args(args, kwargs)
-                                         if (args or kwargs) else ([], {}, []))
+        enc_args, enc_kwargs, escapes, drefs = (
+            self._encode_args(args, kwargs)
+            if (args or kwargs) else ([], {}, [], []))
         task_id = TaskID.from_random().hex()
         spec = TaskSpec.for_actor_call(
             task_id, method_name, enc_args, enc_kwargs, num_returns,
@@ -1731,8 +1861,12 @@ class Worker:
         for oid in spec.return_object_ids():
             self._resolutions[oid] = _Resolution()
             refs.append(ObjectRef(oid, owned=True, worker=self))
-        if escapes:
-            self._pin_args_until_done(escapes, refs)
+        if escapes or drefs:
+            # drefs included: the completion hold keeps a fire-and-forget
+            # call's pinned args alive until the executor is done with them
+            # (see submit_task).
+            self._pin_args_until_done(escapes + drefs, refs)
+        self._register_arg_pins(drefs, refs)
         gen = self._gen_new(spec) if num_returns == STREAMING else None
         pipe = self._actor_pipes.get(actor_id)
         if pipe is None:

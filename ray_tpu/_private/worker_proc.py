@@ -23,7 +23,7 @@ import threading
 import time
 import traceback
 
-from ray_tpu._private import rpc
+from ray_tpu._private import device_store, rpc
 from ray_tpu._private import runtime_env as _rtenv
 from ray_tpu._private.rtconfig import CONFIG
 from ray_tpu._private.serialization import dumps_oob, serialize
@@ -133,6 +133,8 @@ class WorkerProc:
         self._event_win_start = 0.0
         self._event_win_count = 0
         self._advertise_pusher: _BatchPusher | None = None
+        self._pins_flagged = False  # last device_pins state told to the agent
+        self._pins_lock = threading.Lock()  # orders flag updates vs pushes
         self._pid = os.getpid()  # cached: one event record per task must
         # not pay a getpid syscall (worker procs never fork-and-continue)
         self._running = True
@@ -148,6 +150,10 @@ class WorkerProc:
         self.worker.task_cancel_handler = self._cancel_current
         self.worker.gen_ack_handler = self._on_gen_ack
         self.worker.gen_close_handler = self._on_gen_close
+        # Every pin/unpin in this process — task/actor returns, put()s and
+        # dref-arg promotions made INSIDE executing user code alike —
+        # reports 0<->nonzero residency to the agent (idle-reap exemption).
+        device_store.set_pins_listener(self._report_device_pins)
 
         def _rebind_ctrl_pushers():
             # Controller reconnected under us: the batched pushers hold the
@@ -259,6 +265,27 @@ class WorkerProc:
 
         for oid in oids:
             self._prefetch_pool.submit(_fetch, oid)
+
+    def _report_device_pins(self):
+        """device_store pins listener: tell the agent whether this worker
+        currently pins device objects (0<->nonzero transitions only) —
+        pinned pool workers are exempt from the idle reap, they ARE the
+        storage for those objects. The lock orders the stats read, flag
+        update and push: a pin on the exec thread racing a device_free on
+        the IO thread must not publish transitions out of order (a stale
+        trailing pinned=True would exempt an empty worker forever)."""
+        if self.agent_conn is None:
+            return
+        with self._pins_lock:
+            pinned = device_store.table_stats()["count"] > 0
+            if pinned == self._pins_flagged:
+                return
+            self._pins_flagged = pinned
+            try:
+                self.agent_conn.push_threadsafe(
+                    "device_pins", worker_id=self.worker_id, pinned=pinned)
+            except Exception:
+                pass
 
     def _pusher_for(self, conn) -> "_BatchPusher | None":
         """Per-connection batched reply pusher; None once the holder's
@@ -606,6 +633,12 @@ class WorkerProc:
         store with the agent as the advertised holder (it outlives workers).
         Shared by regular returns and streamed generator items so the inline
         threshold / detach / escaping-ref rules can never diverge."""
+        if device_store.eligible(value):
+            # Device object plane: pin the live array here instead of
+            # copying it through the host store; the placeholder rides the
+            # reply/advertise as the inline payload with this worker's
+            # address as the device-location hint (README "Device objects").
+            return device_store.pin_return(oid, value, self.worker)
         sobj = serialize(value, ref_class=ObjectRef)
         if sobj.contained_refs:
             # Returned refs escape to the caller here: refs THIS worker owns
@@ -624,15 +657,28 @@ class WorkerProc:
         self.worker.store.detach(oid)
         return (oid, None, size, self.agent_addr)
 
+    def _advert_item(self, oid: str, size, inline, holder, owner,
+                     error) -> dict:
+        """One register_put advertise record; device-plane results (pinned
+        by _serialize_return) carry the plane marker so the controller can
+        route frees and the producer-death lost sweep."""
+        item = {"oid": oid, "size": size, "inline": inline,
+                "holder": holder, "owner": owner, "error": error}
+        if device_store.holds(oid):
+            item.update(device_store.advert_fields(self.worker_id,
+                                                   self.node_id))
+        return item
+
     def _package_one(self, spec: TaskSpec, idx: int, value) -> tuple:
         """Package ONE yielded stream item, advertising shm items to the
         controller immediately so third-party borrowers can fetch."""
         oid = spec.task_id + idx.to_bytes(4, "little").hex()
         result = self._serialize_return(oid, value)
         if result[3] is not None:
-            self._advertise_pusher.add(
-                {"oid": oid, "size": result[2], "inline": None,
-                 "holder": result[3], "owner": spec.owner_id, "error": None})
+            # result[1] is None for host shm items and the placeholder for
+            # device items — same shape as the non-streaming advertises.
+            self._advertise_pusher.add(self._advert_item(
+                oid, result[2], result[1], result[3], spec.owner_id, None))
         return result
 
     def _stream_generator(self, spec: TaskSpec, value, conn):
@@ -1010,10 +1056,9 @@ class WorkerProc:
         if not will_retry:
             for oid, inline, size, holder in results:
                 if holder is not None:
-                    self._advertise_pusher.add(
-                        {"oid": oid, "size": size, "inline": inline,
-                         "holder": holder, "owner": spec.owner_id,
-                         "error": error_blob})
+                    self._advertise_pusher.add(self._advert_item(
+                        oid, size, inline, holder, spec.owner_id,
+                        error_blob))
         delivered = False
         for _ in range(2):  # a late cancel SIGINT must not lose the report
             try:
@@ -1091,10 +1136,8 @@ class WorkerProc:
         # via the controller's need_object pull.
         for oid, inline, size, holder in results:
             if holder is not None:
-                self._advertise_pusher.add(
-                    {"oid": oid, "size": size, "inline": inline,
-                     "holder": holder, "owner": spec.owner_id,
-                     "error": error_blob})
+                self._advertise_pusher.add(self._advert_item(
+                    oid, size, inline, holder, spec.owner_id, error_blob))
         return {"results": results, "error": error_blob}
 
 

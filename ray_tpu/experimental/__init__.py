@@ -1,0 +1,4 @@
+"""Experimental surfaces (reference ray.experimental): compiled-graph
+channels (`channel`) and the device object plane (`device_objects`)."""
+
+from ray_tpu.experimental import device_objects  # noqa: F401

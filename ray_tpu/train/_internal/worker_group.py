@@ -114,15 +114,24 @@ class WorkerGroup:
         return [w.run.remote(train_fn, config) for w in self.workers]
 
     def poll(self) -> list[dict]:
-        """Per-worker poll; a dead worker loses only ITS reports — the
-        surviving workers' buffered metrics/checkpoints still drain."""
-        out = []
-        for ref in [w.poll.remote() for w in self.workers]:
-            try:
-                out.append(ray_tpu.get(ref, timeout=60))
-            except Exception:
-                pass
-        return out
+        """Poll every worker in ONE batched `ray_tpu.get(refs)` (the old
+        per-ref loop gathered serially: worker k's result waited on k-1
+        slow pollers even when already resolved). Worker-returned arrays
+        (checkpoint shards, eval tensors) ride device refs automatically
+        when the plane is on — poll reports themselves are small dicts.
+        Failure isolation is preserved: if the batch raises, fall back to
+        per-ref gets so a dead worker loses only ITS reports."""
+        refs = [w.poll.remote() for w in self.workers]
+        try:
+            return list(ray_tpu.get(refs, timeout=60))
+        except Exception:
+            out = []
+            for ref in refs:
+                try:
+                    out.append(ray_tpu.get(ref, timeout=60))
+                except Exception:
+                    pass
+            return out
 
     def shutdown(self):
         for w in self.workers:

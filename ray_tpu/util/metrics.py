@@ -26,10 +26,24 @@ def _flush_loop():
         _flush_now()
 
 
+def ensure_flusher() -> None:
+    """Start the background flusher if it isn't running — for sources that
+    report through drain hooks (device-object residency) rather than
+    minting records directly, in processes that might never do the latter."""
+    global _flusher_started
+    with _lock:
+        if _flusher_started:
+            return
+        _flusher_started = True
+    threading.Thread(target=_flush_loop, daemon=True,
+                     name="rt-metrics-flush").start()
+
+
 def _flush_now():
     from ray_tpu._private.worker import global_worker
 
     _drain_task_dispatch()
+    _drain_device_objects()
     with _lock:
         global _pending
         if not _pending:
@@ -45,13 +59,9 @@ def _flush_now():
 
 
 def _record(rec: dict):
-    global _flusher_started
     with _lock:
         _pending.append(rec)
-        if not _flusher_started:
-            _flusher_started = True
-            threading.Thread(target=_flush_loop, daemon=True,
-                             name="rt-metrics-flush").start()
+    ensure_flusher()
 
 
 # --- task dispatch route counters ------------------------------------------
@@ -68,15 +78,10 @@ _task_dispatch_totals = {"direct": 0, "controller": 0}
 def record_task_dispatch(path: str, n: int = 1) -> None:
     """Count `n` task submissions routed via `path` ('direct' or
     'controller'). Called from the submit hot paths — keep it cheap."""
-    global _flusher_started
     with _task_dispatch_lock:
         _task_dispatch_counts[path] = _task_dispatch_counts.get(path, 0) + n
         _task_dispatch_totals[path] = _task_dispatch_totals.get(path, 0) + n
-    with _lock:
-        if not _flusher_started:
-            _flusher_started = True
-            threading.Thread(target=_flush_loop, daemon=True,
-                             name="rt-metrics-flush").start()
+    ensure_flusher()
 
 
 def task_dispatch_counts() -> dict:
@@ -93,6 +98,47 @@ def _drain_task_dispatch() -> None:
             _task_dispatch_counts[p] = 0
     for path, v in deltas.items():
         TASKS_DISPATCHED.inc(v, tags={"path": path})
+
+
+# --- device object residency -------------------------------------------
+# Gauges for the device object plane (README "Device objects"): how many
+# produced arrays are pinned in THIS process's DeviceObjectTable and how
+# many bytes of (device) memory they hold. Tagged per worker — the
+# controller aggregates last-value-wins per tag set, so each producer's
+# residency stays visible. Drained from the table on each flush tick; a
+# mint per pin/free would put a metrics record on the result hot path.
+_last_device_stats: dict | None = None
+
+
+def reset_device_stats_cache() -> None:
+    """Forget the last-reported residency (called on worker shutdown): a
+    NEW session's controller starts with no gauge state, so the first
+    drain there must report even if the values happen to match the
+    previous session's final report."""
+    global _last_device_stats
+    _last_device_stats = None
+
+
+def _drain_device_objects() -> None:
+    global _last_device_stats
+    import sys
+
+    ds = sys.modules.get("ray_tpu._private.device_store")
+    if ds is None:
+        return  # plane never touched in this process
+    try:
+        stats = ds.table_stats()
+    except Exception:
+        return
+    if stats == _last_device_stats:
+        return  # last-value-wins gauge: re-reporting a flat value is noise
+    _last_device_stats = stats
+    from ray_tpu._private.worker import global_worker
+
+    w = global_worker()
+    tags = {"worker_id": (w.worker_id[:12] if w is not None else "")}
+    DEVICE_OBJECTS_COUNT.set(stats["count"], tags=tags)
+    DEVICE_OBJECTS_BYTES.set(stats["bytes"], tags=tags)
 
 
 class Metric:
@@ -168,3 +214,15 @@ TASKS_DISPATCHED = Counter(
     "rt_tasks_dispatched_total",
     description="tasks submitted, by dispatch path",
     tag_keys=("path",))
+
+#: Device object plane residency (see _drain_device_objects): entries and
+#: bytes pinned in each producer's DeviceObjectTable. A count that only
+#: grows means owner-side frees are not reaching producers.
+DEVICE_OBJECTS_COUNT = Gauge(
+    "rt_device_objects_count",
+    description="arrays pinned in this worker's device object table",
+    tag_keys=("worker_id",))
+DEVICE_OBJECTS_BYTES = Gauge(
+    "rt_device_objects_bytes",
+    description="bytes pinned in this worker's device object table",
+    tag_keys=("worker_id",))

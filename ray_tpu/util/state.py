@@ -25,6 +25,11 @@ def list_tasks(limit: int = 1000) -> list[dict]:
 
 
 def list_objects(limit: int = 1000) -> list[dict]:
+    """Directory entries known to the controller. Each row carries a
+    `plane` field: "host" for store/inline objects, "device" for entries
+    whose payload is pinned in the producing worker's DeviceObjectTable
+    (README "Device objects"); device residency totals are the
+    `rt_device_objects_{count,bytes}` gauges in `metrics()`."""
     return _call("list_objects", limit=limit)["objects"]
 
 

@@ -36,6 +36,20 @@ def pytest_collection_modifyitems(config, items):
 
 
 @pytest.fixture
+def device_plane_cpu():
+    """Guard for device-object-plane tests under the tier-1 CPU backend:
+    cpu jax.Arrays exercise the exact same DeviceObjectTable / placeholder /
+    refcount / free-fan-out paths as TPU-resident ones (only the
+    device_put target differs), so the plane is fully testable here. Skips
+    cleanly if jax is unavailable, and asserts the plane wasn't disabled
+    by ambient env (RT_DEVICE_OBJECTS) — these tests are about the plane."""
+    jax = pytest.importorskip("jax")
+    if os.environ.get("RT_DEVICE_OBJECTS", "").lower() in ("0", "false", "no"):
+        pytest.skip("device object plane disabled via RT_DEVICE_OBJECTS")
+    yield jax
+
+
+@pytest.fixture
 def shutdown_only():
     yield None
     ray_tpu.shutdown()

@@ -1,0 +1,94 @@
+"""Chaos: device object plane under producer failure and ref churn.
+
+Pins the two acceptance behaviors of README "Device objects":
+- killing the producing actor mid-pipeline makes the consumer's get()
+  raise a clean ObjectLostError NAMING the lost producer — never a hang;
+- owner-side frees actually reach the producer's DeviceObjectTable
+  (controller -> node agent -> device_free fan-out), so churning refs
+  leaves no pinned-array leak.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+
+N = 1 << 18  # 1MB float32 — well past inline and device thresholds
+
+
+@ray_tpu.remote(num_cpus=0)
+class Producer:
+    def make(self, i):
+        import jax.numpy as jnp
+
+        return jnp.full((N,), float(i), jnp.float32)
+
+    def stats(self):
+        from ray_tpu.experimental import device_objects
+
+        return device_objects.device_object_stats()
+
+
+def test_producer_death_raises_object_lost(ray_start_2cpu, device_plane_cpu):
+    """Kill the producing actor BEFORE the consumer reads: get() must fail
+    fast with ObjectLostError (the value only ever lived in the dead
+    actor's device memory), not hang waiting on a dead address."""
+    p = Producer.remote()
+    ref = p.make.remote(5)
+    done, _ = ray_tpu.wait([ref], num_returns=1, timeout=60)
+    assert done, "producer never finished"
+    ray_tpu.kill(p)
+    time.sleep(1.0)  # let the kill land and the lost sweep run
+    t0 = time.monotonic()
+    with pytest.raises(exc.ObjectLostError) as ei:
+        ray_tpu.get(ref, timeout=30)
+    assert time.monotonic() - t0 < 20, "get() hung instead of failing fast"
+    assert "lost" in str(ei.value)
+    # A second get fails the same way (the failure is sticky, not racy).
+    with pytest.raises(exc.ObjectLostError):
+        ray_tpu.get(ref, timeout=10)
+
+
+def test_producer_death_after_export_keeps_consumers_alive(
+        ray_start_2cpu, device_plane_cpu):
+    """A consumer that ALREADY materialized the object (forcing the shm
+    export) keeps working after the producer dies — the exported copy
+    outlives the producer for reads the driver already resolved."""
+    p = Producer.remote()
+    ref = p.make.remote(3)
+    got = ray_tpu.get(ref, timeout=60)  # forces the tier-1 export
+    ray_tpu.kill(p)
+    time.sleep(0.5)
+    assert float(np.asarray(got).sum()) == 3.0 * N  # live view stays valid
+
+
+def test_freed_refs_empty_table_no_leak(ray_start_2cpu, device_plane_cpu):
+    """100 produce/consume/free iterations: the producer's
+    DeviceObjectTable must drain back to empty (owner-tracked frees reach
+    the producing worker), not grow by one pinned array per iteration."""
+    p = Producer.remote()
+    high_water = 0
+    for i in range(100):
+        ref = p.make.remote(i)
+        if i % 10 == 0:  # exercise the export/free path too, cheaply
+            got = ray_tpu.get(ref, timeout=60)
+            assert float(np.asarray(got)[0]) == float(i)
+            del got
+        del ref
+        if i % 25 == 24:
+            high_water = max(high_water, ray_tpu.get(
+                p.stats.remote(), timeout=60)["count"])
+    # Frees are coalesced (owner flush -> controller -> agent -> worker):
+    # poll for the drain rather than asserting instantaneously.
+    deadline = time.monotonic() + 30
+    stats = None
+    while time.monotonic() < deadline:
+        stats = ray_tpu.get(p.stats.remote(), timeout=60)
+        if stats["count"] == 0:
+            break
+        time.sleep(0.3)
+    assert stats == {"count": 0, "bytes": 0}, (
+        f"device object table leaked: {stats} (high water {high_water})")

@@ -53,15 +53,22 @@ def test_kill_with_restart_no_double_instance(ray_start_2cpu):
     a = Pid.options(max_restarts=5).remote()
     pid1 = ray_tpu.get(a.pid.remote(), timeout=30)
     ray_tpu.kill(a, no_restart=False)
-    # Wait for the restarted instance to answer.
+    # Wait for the RESTARTED instance to answer. A call racing the kill can
+    # still reach the old, not-yet-dead instance and echo pid1 — that's the
+    # kill's asynchrony, not a restart failure — so keep polling until a
+    # different pid answers (deflake: pid1 on the first post-kill call flipped
+    # this test whenever suite timing shifted).
     deadline = time.time() + 30
     pid2 = None
     while time.time() < deadline:
         try:
-            pid2 = ray_tpu.get(a.pid.remote(), timeout=10)
-            break
+            got = ray_tpu.get(a.pid.remote(), timeout=10)
+            if got != pid1:
+                pid2 = got
+                break
         except Exception:
-            time.sleep(0.2)
+            pass
+        time.sleep(0.2)
     assert pid2 is not None and pid2 != pid1
     # Let any stale worker_died report land, then verify: exactly 1 restart
     # consumed and resources not double-released (available <= total).

@@ -1,0 +1,253 @@
+"""Device object plane: actor-resident `jax.Array` ObjectRefs with tiered
+resolution (README "Device objects"; reference: the direct-transport
+GPU-object design — device values stay pinned in the producer and move
+peer-to-peer instead of round-tripping through the object store).
+
+Runs on the tier-1 CPU backend (conftest `device_plane_cpu` guard): cpu
+jax.Arrays exercise the exact same DeviceObjectTable / placeholder /
+refcount / free-fan-out paths as TPU-resident arrays.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.experimental import device_objects
+
+# 128KB float32 — comfortably above RT_DEVICE_OBJECT_MIN_BYTES (100KB).
+N = 1 << 15
+
+
+def _plane_of(oid: str, deadline_s: float = 10.0):
+    """Poll the state API for an object's plane field (advertises are
+    batched one-way pushes, so the directory entry can trail the ref)."""
+    from ray_tpu.util import state
+
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        for o in state.list_objects(limit=100_000):
+            if o["object_id"] == oid:
+                return o["plane"]
+        time.sleep(0.1)
+    return None
+
+
+def test_same_process_get_zero_copy(ray_start_2cpu, device_plane_cpu):
+    """Acceptance pin: a same-process get() of a device object performs
+    ZERO host copies — it returns the live pinned array itself."""
+    jnp = device_plane_cpu.numpy
+    arr = jnp.arange(N, dtype=jnp.float32)
+    ref = ray_tpu.put(arr)
+    got = ray_tpu.get(ref)
+    assert got is arr  # identity, not a reconstruction
+    assert got.unsafe_buffer_pointer() == arr.unsafe_buffer_pointer()
+    # Repeat gets stay zero-copy.
+    assert ray_tpu.get(ref) is arr
+    assert device_objects.device_object_stats()["count"] >= 1
+
+
+def test_actor_return_rides_device_plane(ray_start_2cpu, device_plane_cpu):
+    @ray_tpu.remote(num_cpus=0)
+    class Producer:
+        def make(self, i):
+            import jax.numpy as jnp
+
+            return jnp.full((N,), float(i), jnp.float32)
+
+        def stats(self):
+            from ray_tpu.experimental import device_objects as dob
+
+            return dob.device_object_stats()
+
+    p = Producer.remote()
+    ref = p.make.remote(7)
+    got = ray_tpu.get(ref, timeout=60)
+    # Cross-process tier: a real jax.Array with the right contents.
+    assert isinstance(got, device_plane_cpu.Array)
+    assert np.asarray(got).dtype == np.float32
+    assert float(np.asarray(got).sum()) == 7.0 * N
+    # The payload stayed pinned producer-side...
+    stats = ray_tpu.get(p.stats.remote(), timeout=60)
+    assert stats["count"] >= 1 and stats["bytes"] >= 4 * N
+    # ...and the directory entry is marked device-plane.
+    assert _plane_of(ref.hex()) == "device"
+
+
+def test_arg_handoff_and_second_consumer(ray_start_4cpu, device_plane_cpu):
+    """Producer -> consumer handoff through a ref arg, plus a SECOND
+    consumer of the same ref: both resolve to jax.Arrays with the same
+    contents (the second attaches the existing export — type and value
+    must not depend on which tier served the read)."""
+
+    @ray_tpu.remote(num_cpus=0)
+    class Producer:
+        def make(self):
+            import jax.numpy as jnp
+
+            return jnp.arange(N, dtype=jnp.float32)
+
+    @ray_tpu.remote(num_cpus=0)
+    class Consumer:
+        def consume(self, a):
+            import jax
+
+            assert isinstance(a, jax.Array), type(a)
+            return float(np.asarray(a).sum())
+
+    p = Producer.remote()
+    c1, c2 = Consumer.remote(), Consumer.remote()
+    ref = p.make.remote()
+    expect = float(np.arange(N, dtype=np.float32).sum())
+    assert ray_tpu.get(c1.consume.remote(ref), timeout=60) == expect
+    assert ray_tpu.get(c2.consume.remote(ref), timeout=60) == expect
+
+
+def test_task_return_device_plane(ray_start_2cpu, device_plane_cpu):
+    """Plain (leased-path) task returns ride the plane too."""
+
+    @ray_tpu.remote
+    def mk():
+        import jax.numpy as jnp
+
+        return jnp.ones((N,), jnp.float32)
+
+    got = ray_tpu.get(mk.remote(), timeout=60)
+    assert isinstance(got, device_plane_cpu.Array)
+    assert float(np.asarray(got).sum()) == float(N)
+
+
+def test_device_arg_inlines_placeholder(ray_start_2cpu, device_plane_cpu):
+    """A large jax.Array ARGUMENT is promoted to a device ref whose
+    placeholder rides inside the spec (task_spec.DEVICE_REF) — the
+    executor resolves it peer-to-peer from the driver's table."""
+    jnp = device_plane_cpu.numpy
+
+    @ray_tpu.remote
+    def total(a):
+        import jax
+
+        assert isinstance(a, jax.Array), type(a)
+        return float(np.asarray(a).sum())
+
+    big = jnp.full((N,), 2.0, jnp.float32)
+    ref = total.remote(big)
+    assert ray_tpu.get(ref, timeout=60) == 2.0 * N
+    # The driver's table holds the pinned arg while the result ref lives...
+    assert device_objects.device_object_stats()["count"] >= 1
+    # ...and releases it when the result ref dies (a fresh-array-per-call
+    # loop must not accrete one pinned arg per iteration).
+    del ref
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if device_objects.device_object_stats()["count"] == 0:
+            break
+        time.sleep(0.1)
+    assert device_objects.device_object_stats()["count"] == 0
+
+
+def test_fire_and_forget_device_arg(ray_start_2cpu, device_plane_cpu):
+    """The ubiquitous fire-and-forget pattern — submit with a big array
+    arg, drop the result ref immediately — must not free the pinned arg
+    before the executor decodes it (the until-task-done hold)."""
+    jnp = device_plane_cpu.numpy
+
+    @ray_tpu.remote(num_cpus=0)
+    class Sink:
+        def __init__(self):
+            self.total = 0.0
+
+        def update(self, a):
+            self.total += float(np.asarray(a).sum())
+
+        def read(self):
+            return self.total
+
+    s = Sink.remote()
+    for i in range(5):
+        s.update.remote(jnp.full((N,), float(i), jnp.float32))  # ref dropped
+    assert ray_tpu.get(s.read.remote(), timeout=60) == sum(
+        float(i) * N for i in range(5))
+    # ...and once the calls completed, the dropped refs release the pins.
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if device_objects.device_object_stats()["count"] == 0:
+            break
+        time.sleep(0.1)
+    assert device_objects.device_object_stats()["count"] == 0
+
+
+def test_plane_off_restores_host_path(shutdown_only, device_plane_cpu):
+    """RT_DEVICE_OBJECTS=0 (here via _system_config) restores the host
+    store path: values copy through shm/inline exactly as before — no
+    pinning, no identity get, plane column reads "host"."""
+    ray_tpu.init(num_cpus=2, _system_config={"device_objects": False})
+    jnp = device_plane_cpu.numpy
+    arr = jnp.arange(N, dtype=jnp.float32)
+    ref = ray_tpu.put(arr)
+    got = ray_tpu.get(ref)
+    assert got is not arr  # host path reconstructs a copy
+    assert np.array_equal(np.asarray(got), np.asarray(arr))
+    assert device_objects.device_object_stats()["count"] == 0
+    assert not device_objects.is_enabled()
+    assert _plane_of(ref.hex()) == "host"
+
+    @ray_tpu.remote(num_cpus=0)
+    class Producer:
+        def make(self):
+            import jax.numpy as jnp
+
+            return jnp.ones((N,), jnp.float32)
+
+        def stats(self):
+            from ray_tpu.experimental import device_objects as dob
+
+            return dob.device_object_stats()
+
+    p = Producer.remote()
+    r = p.make.remote()
+    assert float(np.asarray(ray_tpu.get(r, timeout=60)).sum()) == float(N)
+    assert ray_tpu.get(p.stats.remote(), timeout=60)["count"] == 0
+    assert _plane_of(r.hex()) == "host"
+
+
+def test_small_and_sharded_arrays_fall_back(ray_start_2cpu, device_plane_cpu):
+    """Sub-threshold arrays stay on the host/inline path; multi-device
+    sharded arrays are not eligible (warn-once host fallback)."""
+    jax, jnp = device_plane_cpu, device_plane_cpu.numpy
+    small = jnp.arange(16, dtype=jnp.float32)
+    assert not device_objects.would_ride_device_plane(small)
+    ref = ray_tpu.put(small)
+    assert ray_tpu.get(ref) is not small  # inline host path
+    big = jnp.arange(N, dtype=jnp.float32)
+    assert device_objects.would_ride_device_plane(big)
+    if len(jax.devices()) > 1:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        mesh = jax.sharding.Mesh(np.array(jax.devices()), ("d",))
+        sharded = jax.device_put(big, NamedSharding(mesh, P("d")))
+        assert not device_objects.would_ride_device_plane(sharded)
+        # Round-trips intact through the host fallback.
+        assert np.array_equal(
+            np.asarray(ray_tpu.get(ray_tpu.put(sharded))), np.asarray(big))
+
+
+def test_device_residency_gauges(ray_start_2cpu, device_plane_cpu):
+    """The rt_device_objects_{count,bytes} gauges surface table residency
+    through the metrics pipeline / state API."""
+    from ray_tpu.util import state
+
+    jnp = device_plane_cpu.numpy
+    ref = ray_tpu.put(jnp.arange(N, dtype=jnp.float32))  # pins locally
+    assert ref is not None
+    deadline = time.monotonic() + 15
+    seen = {}
+    while time.monotonic() < deadline:
+        seen = {m["name"]: m["value"] for m in state.metrics()
+                if m["name"].startswith("rt_device_objects")}
+        if seen.get("rt_device_objects_count", 0) >= 1:
+            break
+        time.sleep(0.25)
+    assert seen.get("rt_device_objects_count", 0) >= 1, seen
+    assert seen.get("rt_device_objects_bytes", 0) >= 4 * N, seen

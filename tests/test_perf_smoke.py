@@ -36,3 +36,15 @@ def test_bench_smoke_runs():
     assert direct > 1.2 * ctrl, (
         f"direct dispatch ({direct}/s) does not beat the controller path "
         f"({ctrl}/s)")
+    # Device object plane A/B: actor→actor 64MB jax.Array handoff must
+    # beat the host-store path (RT_DEVICE_OBJECTS=0) by a clear margin —
+    # the plane skips the producer-side host materialization the host
+    # path pays at return time (README "Device objects").
+    dev = rep["details"].get("device_object_p2p_gbps")
+    host = rep["details"].get("device_object_p2p_host_gbps")
+    assert dev is not None and host is not None, (
+        "device_object_p2p A/B missing (bench skipped it: see its stderr)")
+    assert host > 0, f"host-store path measured {host} GB/s"
+    assert dev > 1.5 * host, (
+        f"device object plane ({dev} GB/s) does not beat the host store "
+        f"path ({host} GB/s) by 1.5x")
