@@ -260,22 +260,59 @@ class Controller:
     # share fate with the controller, so re-creation is the contract).
 
     def _persist_path(self) -> str:
-        return os.path.join(CONFIG.controller_persist_dir, "controller_state.pkl")
+        # controller_persist_dir may be any storage-plane URI (local path,
+        # local://, sim://) — snapshots ride the same pluggable backend as
+        # train/tune/workflow checkpoints (README "Checkpointing & storage").
+        from ray_tpu import storage
+
+        return storage.join(CONFIG.controller_persist_dir,
+                            "controller_state.pkl")
 
     def _mark_dirty(self):
         self._persist_dirty = True
 
     def _restore_state(self):
         import pickle
+        import time as _time
+
+        from ray_tpu import storage
 
         path = self._persist_path()
-        if not os.path.exists(path):
-            return
+        # Read with a short transient-retry budget: a blipping REMOTE
+        # persist backend (sim://, future object stores) must not be
+        # mistaken for corruption — quarantining an intact snapshot would
+        # let the persist loop later overwrite it with empty state.
+        data = None
+        delay = 0.1
+        for attempt in range(4):
+            try:
+                if not storage.exists(path):
+                    return
+                data = storage.get_bytes(path)
+                break
+            except storage.StorageTransientError:
+                if attempt == 3:
+                    logger.exception(
+                        "controller: persist backend unreachable reading "
+                        "%s; starting fresh WITHOUT quarantining (the "
+                        "snapshot may be intact)", path)
+                    return
+                _time.sleep(delay)
+                delay *= 2
         try:
-            with open(path, "rb") as f:
-                snap = pickle.load(f)
+            snap = pickle.loads(data)
         except Exception:
-            logger.exception("controller: persisted state unreadable; starting fresh")
+            # A corrupt/truncated snapshot must not crash-loop the
+            # controller: quarantine the bad file (kept for forensics
+            # under a .corrupt suffix) and start fresh — re-persist will
+            # atomically write a good one.
+            logger.exception(
+                "controller: persisted state unreadable; quarantining %s "
+                "and starting fresh", path)
+            try:
+                storage.rename(path, path + ".corrupt")
+            except Exception:
+                logger.exception("controller: quarantine rename failed")
             return
         self.kv = snap.get("kv", {})
         self.named_actors = snap.get("named_actors", {})
@@ -343,16 +380,15 @@ class Controller:
     def _dump_snapshot(self, snap: dict):
         import pickle
 
+        from ray_tpu import storage
+
         # Serializes the threaded persist-loop dump against stop()'s final
-        # synchronous flush: both target the same tmp file, and the LAST
-        # writer must be the newest snapshot.
+        # synchronous flush: the LAST writer must be the newest snapshot.
+        # storage.put is atomic on every backend (tmp + rename on the
+        # local fs), preserving the old atomic-replace contract.
         with self._persist_io_lock:
-            os.makedirs(CONFIG.controller_persist_dir, exist_ok=True)
-            path = self._persist_path()
-            tmp = path + ".tmp"
-            with open(tmp, "wb") as f:
-                pickle.dump(snap, f, protocol=5)
-            os.replace(tmp, path)
+            storage.put(self._persist_path(),
+                        pickle.dumps(snap, protocol=5))
 
     def _write_snapshot(self):
         self._dump_snapshot(self._build_snapshot())

@@ -308,6 +308,19 @@ def on_worker_shutdown() -> None:
 
 
 # -------------------------------------------------------------- producer
+def host_view(arr):
+    """Host ndarray for a (single-device or shard) jax array: a ZERO-COPY
+    view of the array's host memory on CPU/TPU-host backends, a D2H copy
+    elsewhere. jax arrays are immutable, so sharing the view is safe for
+    readers that outlive the call (the export path below) as long as they
+    hold a reference — EXCEPT under XLA buffer donation, which frees the
+    memory behind the view; long-lived readers must copy views that don't
+    own their data (see checkpoint._snapshot_leaf)."""
+    import numpy as np
+
+    return np.asarray(arr)
+
+
 def export_to_store(oid: str, store) -> bool:
     """Materialize a pinned array's bytes into the local shm store (the
     same-host / cross-host serving copy). The export blob deserializes to a
@@ -316,8 +329,6 @@ def export_to_store(oid: str, store) -> bool:
     straight into the destination mmap by put_serialized: ONE host copy
     total, no pickle of the payload. Idempotent; returns False if the oid
     is neither pinned nor already exported."""
-    import numpy as np
-
     from ray_tpu._private.serialization import serialize
 
     arr = _TABLE.get(oid)
@@ -325,7 +336,7 @@ def export_to_store(oid: str, store) -> bool:
         return store.contains(oid)
     if store.contains(oid):
         return True  # repeat consumers attach the existing export for free
-    nd = np.asarray(arr)  # zero-copy view on host backends
+    nd = host_view(arr)  # zero-copy view on host backends
     sobj = serialize(_ExportWrap(nd))
     store.put_serialized(oid, sobj)
     return True

@@ -121,6 +121,39 @@ _flag("rpc_wbuf_high_bytes", int, 4 << 20)
 _flag("rpc_join_bytes", int, 128 << 10)
 # Fixed-point resource arithmetic granularity (reference fixed_point.h uses 1e-4).
 _flag("resource_unit", int, 10000)
+# --- storage plane / checkpoint engine (README "Checkpointing & storage") --
+# Async checkpointing: save_async snapshots device->host synchronously and
+# streams shards to the storage backend off the step path; the manifest
+# rename is the commit point. False restores fully synchronous saves
+# (byte-identical output, report()/save() block until committed).
+_flag("ckpt_async", bool, True)
+# Keep-last-K retention enforced by the engine after each commit (pinned
+# checkpoints — e.g. a PBT clone's restore source — are never collected).
+# 0 = unlimited.
+_flag("ckpt_keep", int, 0)
+# Snapshot safety: host-view shard snapshots that do not own their memory
+# (zero-copy views on CPU/TPU-host backends) are copied before save_async
+# returns, so XLA buffer donation in the next step cannot corrupt the
+# in-flight write. 0 = keep zero-copy views (donation-free loops only).
+_flag("ckpt_snapshot_copy", bool, True)
+# Transient storage failures (StorageTransientError: sim:// injected
+# faults, real network blips) are retried this many times with exponential
+# backoff starting at ckpt_retry_base_s before the save fails.
+_flag("ckpt_retries", int, 4)
+_flag("ckpt_retry_base_s", float, 0.05)
+# Multi-rank commit: rank 0 waits this long for every rank's shard
+# metadata to appear in storage before declaring the save failed (the
+# barrier rides storage, not RPC — a crashed rank simply never commits).
+_flag("ckpt_commit_timeout_s", float, 120.0)
+# Uncommitted partial checkpoint dirs (no manifest) younger than this are
+# presumed in-flight and skipped by GC; older ones are collected.
+_flag("ckpt_partial_grace_s", float, 600.0)
+# sim:// backend shaping (storage/sim.py): per-op latency, put/get
+# bandwidth cap (GB/s, 0 = unlimited), and a hard "network partition"
+# switch under which every op raises StorageTransientError.
+_flag("sim_storage_latency_s", float, 0.0)
+_flag("sim_storage_gbps", float, 0.0)
+_flag("sim_storage_severed", bool, False)
 
 
 class _Config:

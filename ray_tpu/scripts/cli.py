@@ -300,6 +300,49 @@ def cmd_job(args) -> int:
         client.close()
 
 
+def cmd_checkpoints(args) -> int:
+    """`ray-tpu checkpoints` — checkpoint observability (README
+    "Checkpointing & storage"). With --path, scans a storage URI directly
+    (committed + in-flight partial rows, no cluster needed); otherwise
+    lists the cluster-wide registry every engine commit registers in the
+    controller KV."""
+    rows: list[dict]
+    if args.path:
+        from ray_tpu.train import checkpoint as ckpt_mod
+
+        rows = ckpt_mod.list_checkpoints(args.path)
+    else:
+        address = _resolve_address(args)
+        keys = _rpc_call(address, "kv_keys", ns="_checkpoints",
+                         prefix="")["keys"]
+        rows = []
+        for key in sorted(keys):
+            val = _rpc_call(address, "kv_get", ns="_checkpoints",
+                            key=key)["value"]
+            if val is None:
+                continue
+            try:
+                rows.append(json.loads(val))
+            except ValueError:
+                pass
+        rows.sort(key=lambda r: r.get("created") or 0)
+    if not rows:
+        print("no checkpoints")
+        return 0
+    print(f"{'STEP':>6}  {'KIND':<9} {'BYTES':>12}  {'STATE':<9} URI")
+    for r in rows:
+        committed = r.get("committed", True)
+        state = "committed" if committed else "partial"
+        if r.get("pins"):
+            state += f"+{len(r['pins'])}pin"
+        step = r.get("step")
+        print(f"{step if step is not None else '-':>6}  "
+              f"{(r.get('kind') or '-'):<9} "
+              f"{(r.get('bytes') if r.get('bytes') is not None else '-'):>12}  "
+              f"{state:<9} {r.get('uri') or r.get('name')}")
+    return 0
+
+
 def cmd_dashboard(args) -> int:
     from ray_tpu.dashboard import Dashboard
 
@@ -349,6 +392,15 @@ def main(argv=None) -> int:
         jp.add_argument("submission_id")
     jsub.add_parser("list")
     pj.set_defaults(fn=cmd_job)
+
+    pc = sub.add_parser("checkpoints",
+                        help="list checkpoints (cluster registry or a "
+                             "storage URI)")
+    pc.add_argument("--address", default=None)
+    pc.add_argument("--path", default=None,
+                    help="storage URI to scan directly (local://, sim://, "
+                         "a bare path)")
+    pc.set_defaults(fn=cmd_checkpoints)
 
     pd = sub.add_parser("dashboard", help="serve the HTTP dashboard")
     pd.add_argument("--address", default=None)

@@ -17,6 +17,8 @@ import uuid
 from typing import Optional
 
 import ray_tpu
+from ray_tpu import storage
+from ray_tpu.train import checkpoint as ckpt_mod
 from ray_tpu.train._internal.worker_group import WorkerGroup
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.config import FailureConfig, RunConfig, ScalingConfig
@@ -51,8 +53,11 @@ class TrainController:
         self.run_config = run_config
         self.datasets = datasets or {}
         self.run_name = run_config.name or f"train_{uuid.uuid4().hex[:8]}"
-        self.storage_dir = os.path.join(run_config.resolved_storage(), self.run_name)
-        os.makedirs(self.storage_dir, exist_ok=True)
+        # storage_path may be any storage-plane URI (local path, local://,
+        # mem://, sim://) — every durable byte below rides the backend.
+        self.storage_dir = storage.join(run_config.resolved_storage(),
+                                        self.run_name)
+        storage.makedirs(self.storage_dir)
         self.latest_checkpoint: Optional[Checkpoint] = None
         self.metrics_history: list[dict] = []
         self._checkpoint_paths: list[str] = []
@@ -183,13 +188,21 @@ class TrainController:
         keep = self.run_config.checkpoint_config.num_to_keep
         if not keep:
             return
-        import shutil
-
         while len(self._checkpoint_paths) > keep:
-            victim = self._checkpoint_paths.pop(0)
+            victim = self._checkpoint_paths[0]
             if self.latest_checkpoint and victim == self.latest_checkpoint.path:
+                self._checkpoint_paths.pop(0)
                 continue
-            shutil.rmtree(victim, ignore_errors=True)
+            # Backend delete, pin-aware: a checkpoint some other consumer
+            # pinned (e.g. a Tune PBT clone restoring from this run)
+            # survives until its last owner unpins — it stays TRACKED so a
+            # later prune pass (the next report) retries the delete.
+            try:
+                if not ckpt_mod.delete_checkpoint(victim):
+                    break  # oldest victim is pinned; retry next prune
+            except Exception:
+                logger.exception("checkpoint prune failed for %s", victim)
+            self._checkpoint_paths.pop(0)
 
     def _run_attempt(self, group: WorkerGroup) -> dict:
         run_refs = group.run_async(self.train_fn, self.config)

@@ -57,9 +57,17 @@ class TrainWorkerActor:
 
             takes_config = len(inspect.signature(train_fn).parameters) >= 1
             result = train_fn(config) if takes_config else train_fn()
+            # Async checkpoint saves release their report entries on
+            # commit: make every one durable+visible before the
+            # controller's final drain.
+            s.flush_checkpoints()
             s.finished = True
             return {"ok": True, "result": result}
         except BaseException:
+            try:
+                s.flush_checkpoints()
+            except Exception:
+                pass
             s.finished = True
             return {"ok": False, "error": traceback.format_exc()}
 

@@ -23,9 +23,12 @@ class TrialRunner:
     per-trial resources can be attached."""
 
     def __init__(self, trainable, config: dict, trial_id: str, trial_dir: str,
-                 restore_from: Optional[str] = None):
-        os.makedirs(trial_dir, exist_ok=True)
-        self.sess = _session.init_session(trial_id, trial_dir, restore_from)
+                 restore_from: Optional[str] = None, incarnation: int = 0):
+        from ray_tpu import storage
+
+        storage.makedirs(trial_dir)
+        self.sess = _session.init_session(trial_id, trial_dir, restore_from,
+                                          incarnation)
         self.trainable = trainable
         self.config = config
         self._thread: Optional[threading.Thread] = None
@@ -61,7 +64,10 @@ class TrialRunner:
         if hasattr(t, "setup"):
             t.setup(self.config)
         if sess.restore_from and hasattr(t, "load_checkpoint"):
-            t.load_checkpoint(sess.restore_from)
+            # Materialize through the storage plane when the checkpoint
+            # lives on a non-local backend; local dirs pass through as-is.
+            with Checkpoint(sess.restore_from).as_directory() as d:
+                t.load_checkpoint(d)
         while not sess.stopped.is_set():
             result = t.step()
             ckpt = None

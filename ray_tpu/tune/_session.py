@@ -10,12 +10,12 @@ cadence aligned with scheduler decisions.
 
 from __future__ import annotations
 
-import os
 import queue
-import shutil
 import threading
 from typing import Optional
 
+from ray_tpu import storage
+from ray_tpu.train import checkpoint as ckpt_mod
 from ray_tpu.train.checkpoint import Checkpoint
 
 _session: Optional["_TuneSession"] = None
@@ -29,10 +29,16 @@ class StopTrial(BaseException):
 
 class _TuneSession:
     def __init__(self, trial_id: str, trial_dir: str,
-                 restore_from: Optional[str]):
+                 restore_from: Optional[str], incarnation: int = 0):
         self.trial_id = trial_id
         self.trial_dir = trial_dir
         self.restore_from = restore_from
+        # Which start of this trial we are (error restarts, PBT exploits):
+        # checkpoint dirs are namespaced by it so a restarted trial can
+        # never OVERWRITE an earlier incarnation's checkpoint — which a
+        # PBT clone may have pinned as its restore source (pins prevent
+        # deletion; unique names prevent overwrite).
+        self.incarnation = incarnation
         self.queue: "queue.Queue" = queue.Queue(maxsize=1)
         self.stopped = threading.Event()
         self.iteration = 0
@@ -45,10 +51,21 @@ class _TuneSession:
         ckpt_path = None
         if checkpoint is not None:
             self._ckpt_seq += 1
-            ckpt_path = os.path.join(self.trial_dir,
-                                     f"checkpoint_{self._ckpt_seq:06d}")
-            if os.path.abspath(checkpoint.path) != os.path.abspath(ckpt_path):
-                shutil.copytree(checkpoint.path, ckpt_path, dirs_exist_ok=True)
+            ckpt_path = storage.join(
+                self.trial_dir,
+                f"checkpoint_i{self.incarnation}_{self._ckpt_seq:06d}")
+            if checkpoint.path != ckpt_path:
+                # Through the storage seam: manifest-committed upload
+                # (sync — tune cadence is controller-paced), then
+                # keep-last-K retention. Pinned checkpoints (a PBT
+                # clone's restore donor) survive retention.
+                with checkpoint.as_directory() as src:
+                    ckpt_mod.upload_directory(src, ckpt_path,
+                                              step=self._ckpt_seq)
+                from ray_tpu._private.rtconfig import CONFIG
+
+                if CONFIG.ckpt_keep:
+                    ckpt_mod.retention(self.trial_dir, CONFIG.ckpt_keep)
         metrics = dict(metrics)
         metrics.setdefault("training_iteration", self.iteration)
         self.queue.put(("report", metrics, ckpt_path))
@@ -59,10 +76,12 @@ class _TuneSession:
         return None
 
 
-def init_session(trial_id: str, trial_dir: str, restore_from: Optional[str]) -> _TuneSession:
+def init_session(trial_id: str, trial_dir: str, restore_from: Optional[str],
+                 incarnation: int = 0) -> _TuneSession:
     global _session
     with _lock:
-        _session = _TuneSession(trial_id, trial_dir, restore_from)
+        _session = _TuneSession(trial_id, trial_dir, restore_from,
+                                incarnation)
         return _session
 
 

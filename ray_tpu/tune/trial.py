@@ -26,6 +26,14 @@ class Trial:
         self.results: list[dict] = []
         self.checkpoint_path: Optional[str] = None
         self.restore_from: Optional[str] = None  # set by PBT exploit
+        #: checkpoint dir this trial pinned as its restore source (PBT
+        #: clone-from-donor / error restart); released by the controller
+        #: once the trial checkpoints for itself or stops.
+        self.pinned_source: Optional[str] = None
+        #: how many times this trial has been started (error restarts, PBT
+        #: exploits); namespaces checkpoint dirs so a restart never
+        #: overwrites an earlier incarnation's (possibly pinned) checkpoint.
+        self.incarnation = 0
         self.error: Optional[str] = None
         self.iteration = 0
         # scheduler scratch (e.g. ASHA rungs this trial has been recorded at)

@@ -22,7 +22,9 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
 import ray_tpu
+from ray_tpu import storage
 from ray_tpu.train import JaxTrainer, RunConfig
+from ray_tpu.train import checkpoint as ckpt_mod
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.tune import schedulers as sched_mod
 from ray_tpu.tune._runner import TrialRunner
@@ -133,7 +135,7 @@ class TuneController:
         else:
             self.trials = [Trial(cfg, "") for cfg in configs]
         for t in self.trials:
-            t.trial_dir = os.path.join(exp_dir, f"trial_{t.trial_id}")
+            t.trial_dir = storage.join(exp_dir, f"trial_{t.trial_id}")
         if self.searcher is not None:
             self.searcher.set_search_properties(
                 tune_config.metric, tune_config.mode, self.param_space)
@@ -162,9 +164,10 @@ class TuneController:
 
     def _start(self, trial: Trial):
         runner_cls = self._remote_runner()
+        trial.incarnation += 1
         trial.runner = runner_cls.remote(
             self.trainable, trial.config, trial.trial_id, trial.trial_dir,
-            trial.restore_from)
+            trial.restore_from, trial.incarnation - 1)
         trial.runner.start.remote()
         trial.status = RUNNING
         self._ask(trial)
@@ -187,6 +190,7 @@ class TuneController:
         trial.status = status
         trial.error = error
         self._kill(trial)
+        self._release_restore_pin(trial)
         self.scheduler.on_trial_complete(self, trial)
         if self.searcher is not None:
             self.searcher.on_trial_complete(trial.trial_id, trial.last_result)
@@ -195,13 +199,37 @@ class TuneController:
         except Exception:
             logger.exception("tune: experiment-state save failed")
 
+    # --------------------------------------------------- checkpoint pinning
+    # A trial restoring from a checkpoint it does not own (PBT exploit
+    # clones from a donor; error-restarts re-read the trial's own last
+    # dir) must keep that dir alive: the donor's retention/GC or a later
+    # overwrite would otherwise corrupt the clone's restore source. Pins
+    # are refcount marker files on the storage backend — visible to the
+    # donor's session process — released once the trial has written a
+    # checkpoint of its own (or stopped).
+    def _pin_restore_source(self, trial: Trial, path: Optional[str]):
+        self._release_restore_pin(trial)
+        trial.restore_from = path
+        if path:
+            try:
+                ckpt_mod.pin(path, owner=f"trial-{trial.trial_id}")
+                trial.pinned_source = path
+            except Exception:
+                logger.exception("tune: pinning %s failed", path)
+
+    def _release_restore_pin(self, trial: Trial):
+        if trial.pinned_source:
+            ckpt_mod.unpin(trial.pinned_source,
+                           owner=f"trial-{trial.trial_id}")
+            trial.pinned_source = None
+
     def exploit(self, trial: Trial, donor: Trial, new_config: dict):
         """PBT: restart `trial` from donor's checkpoint with a perturbed
         config (reference pbt.py _exploit:405)."""
         logger.info("tune: trial %s exploits %s", trial.trial_id, donor.trial_id)
         self._kill(trial)
         trial.config = new_config
-        trial.restore_from = donor.checkpoint_path
+        self._pin_restore_source(trial, donor.checkpoint_path)
         self._start(trial)
 
     # ----------------------------------------------------- experiment state
@@ -242,10 +270,10 @@ class TuneController:
             except (TypeError, ValueError):
                 return repr(o)
 
-        tmp = os.path.join(self.exp_dir, ".experiment_state.tmp")
-        with open(tmp, "w") as f:
-            json.dump(state, f, default=_default)
-        os.replace(tmp, os.path.join(self.exp_dir, "experiment_state.json"))
+        # storage.put is atomic on every backend — the experiment state
+        # file is either the old or the new version, never torn.
+        storage.put(storage.join(self.exp_dir, "experiment_state.json"),
+                    json.dumps(state, default=_default).encode())
 
     def _maybe_suggest(self) -> Optional[Trial]:
         """Searcher-driven trial creation (sequential; reference
@@ -257,7 +285,7 @@ class TuneController:
         if cfg is None:
             return None
         t.config = cfg
-        t.trial_dir = os.path.join(self.exp_dir, f"trial_{t.trial_id}")
+        t.trial_dir = storage.join(self.exp_dir, f"trial_{t.trial_id}")
         self.trials.append(t)
         return t
 
@@ -319,6 +347,9 @@ class TuneController:
             trial.iteration = metrics.get("training_iteration", trial.iteration)
             if ckpt_path:
                 trial.checkpoint_path = ckpt_path
+                # The trial now owns a durable checkpoint of its own: the
+                # borrowed restore source (if any) can be collected.
+                self._release_restore_pin(trial)
             if kind == "final":
                 self._stop_trial(trial)
                 return
@@ -350,7 +381,7 @@ class TuneController:
             logger.warning("tune: trial %s failed (%d/%s), restarting",
                            trial.trial_id, n + 1, maxf)
             self._kill(trial)
-            trial.restore_from = trial.checkpoint_path
+            self._pin_restore_source(trial, trial.checkpoint_path)
             self._start(trial)
         else:
             logger.error("tune: trial %s failed:\n%s", trial.trial_id, err)
@@ -419,8 +450,8 @@ class Tuner:
 
         import cloudpickle
 
-        with open(os.path.join(path, "experiment_state.json")) as f:
-            state = json.load(f)
+        state = json.loads(storage.get_bytes(
+            storage.join(path, "experiment_state.json")))
         if trainable is None:
             trainable = cloudpickle.loads(
                 bytes.fromhex(state["trainable"]))
@@ -462,8 +493,8 @@ class Tuner:
             exp_dir = self._exp_dir
         else:
             name = self._run_config.name or f"tune_{int(time.time())}"
-            exp_dir = os.path.join(self._run_config.resolved_storage(), name)
-        os.makedirs(exp_dir, exist_ok=True)
+            exp_dir = storage.join(self._run_config.resolved_storage(), name)
+        storage.makedirs(exp_dir)
         if self._restored_trials is not None:
             configs = []
         elif tc.search_alg is not None:

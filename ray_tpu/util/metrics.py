@@ -226,3 +226,20 @@ DEVICE_OBJECTS_BYTES = Gauge(
     "rt_device_objects_bytes",
     description="bytes pinned in this worker's device object table",
     tag_keys=("worker_id",))
+
+#: Checkpoint engine (README "Checkpointing & storage"), minted at each
+#: manifest commit by train/checkpoint.py. save_seconds is snapshot->commit
+#: wall time tagged by mode (async saves run off the step path; their
+#: duration is hidden from training, sync ones are on it); a bytes/committed
+#: ratio drifting up means checkpoints are growing.
+CHECKPOINT_SAVE_SECONDS = Histogram(
+    "rt_checkpoint_save_seconds",
+    description="checkpoint save duration, snapshot to manifest commit",
+    boundaries=[0.01, 0.05, 0.25, 1.0, 5.0, 30.0, 120.0, 600.0],
+    tag_keys=("mode",))
+CHECKPOINT_BYTES = Counter(
+    "rt_checkpoint_bytes_total",
+    description="bytes committed to checkpoint storage")
+CHECKPOINT_COMMITTED = Counter(
+    "rt_checkpoint_committed_total",
+    description="checkpoints committed (manifest rename succeeded)")

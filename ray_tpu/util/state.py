@@ -49,6 +49,31 @@ def list_placement_groups() -> list[dict]:
     return [{"pg_id": pid, **info} for pid, info in snap.get("pgs", {}).items()]
 
 
+def list_checkpoints(path: str | None = None, limit: int = 1000) -> list[dict]:
+    """Committed checkpoints. With `path` (any storage-plane URI), the
+    directory is scanned directly — committed AND in-flight partial rows,
+    no cluster needed. Without it, the cluster-wide registry is queried:
+    every engine commit registers best-effort in the controller KV
+    (`_checkpoints` namespace), so rows survive the saving worker."""
+    if path is not None:
+        from ray_tpu.train import checkpoint as ckpt_mod
+
+        return ckpt_mod.list_checkpoints(path)[:limit]
+    import json
+
+    rows = []
+    for key in _call("kv_keys", ns="_checkpoints", prefix="")["keys"][:limit]:
+        val = _call("kv_get", ns="_checkpoints", key=key)["value"]
+        if val is None:
+            continue
+        try:
+            rows.append(json.loads(val))
+        except ValueError:
+            pass
+    rows.sort(key=lambda r: r.get("created") or 0)
+    return rows
+
+
 def metrics() -> list[dict]:
     """Aggregated application metrics (ray_tpu.util.metrics Counter/Gauge/
     Histogram series, reference `ray metrics` / Prometheus export)."""

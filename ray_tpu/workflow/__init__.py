@@ -6,6 +6,11 @@ workflow is a DAG of `.bind()`ed remote functions; every step's result is
 checkpointed to storage under a deterministic step key, so `resume()` (or
 simply re-`run`ning the same workflow_id) skips completed steps — the
 recovery contract that makes long pipelines restartable.
+
+Step memoization rides the pluggable storage plane (`ray_tpu/storage/`):
+`init(storage=...)` accepts any backend URI (`local://`, `mem://`,
+`sim://`, a bare path), and every step write is atomic on the backend —
+a crash mid-write never half-memoizes a step.
 """
 
 from __future__ import annotations
@@ -16,6 +21,7 @@ import pickle
 from typing import Any, Optional
 
 import ray_tpu
+from ray_tpu import storage as _st
 
 _STORAGE = os.path.expanduser("~/ray_tpu_workflows")
 
@@ -39,14 +45,15 @@ def bind(remote_fn, *args, **kwargs) -> DAGNode:
 
 
 def init(storage: Optional[str] = None):
+    """Point the workflow store at a storage-plane URI (or local path)."""
     global _STORAGE
     if storage:
         _STORAGE = storage
-    os.makedirs(_STORAGE, exist_ok=True)
+    _st.makedirs(_STORAGE)
 
 
 def _step_dir(workflow_id: str) -> str:
-    return os.path.join(_STORAGE, workflow_id, "steps")
+    return _st.join(_STORAGE, workflow_id, "steps")
 
 
 def _hash_const(h, c):
@@ -123,17 +130,13 @@ def _run_node(node: Any, workflow_id: str, stats: dict):
         if ck:
             child_keys.append(ck)
     key = _step_key(node, child_keys)
-    path = os.path.join(_step_dir(workflow_id), key + ".pkl")
-    if os.path.exists(path):
-        with open(path, "rb") as f:
-            stats["skipped"] += 1
-            return pickle.load(f), key
+    path = _st.join(_step_dir(workflow_id), key + ".pkl")
+    if _st.exists(path):
+        stats["skipped"] += 1
+        return pickle.loads(_st.get_bytes(path)), key
     value = ray_tpu.get(node.fn.remote(*args, **kwargs), timeout=600)
-    os.makedirs(os.path.dirname(path), exist_ok=True)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        pickle.dump(value, f)
-    os.replace(tmp, path)  # atomic: a crash mid-write never half-memoizes
+    # Backend puts are atomic: a crash mid-write never half-memoizes.
+    _st.put(path, pickle.dumps(value))
     stats["executed"] += 1
     return value, key
 
@@ -145,8 +148,8 @@ def run(dag: DAGNode, *, workflow_id: str) -> Any:
     stats = {"executed": 0, "skipped": 0}
     value, _ = _run_node(dag, workflow_id, stats)
     meta = {"workflow_id": workflow_id, "status": "SUCCESSFUL", **stats}
-    with open(os.path.join(_STORAGE, workflow_id, "result.pkl"), "wb") as f:
-        pickle.dump({"value": value, "meta": meta}, f)
+    _st.put(_st.join(_STORAGE, workflow_id, "result.pkl"),
+            pickle.dumps({"value": value, "meta": meta}))
     return value
 
 
@@ -155,26 +158,21 @@ def resume(workflow_id: str, dag: Optional[DAGNode] = None) -> Any:
     does the skipping); without it, return the stored final result."""
     if dag is not None:
         return run(dag, workflow_id=workflow_id)
-    path = os.path.join(_STORAGE, workflow_id, "result.pkl")
-    if not os.path.exists(path):
+    path = _st.join(_STORAGE, workflow_id, "result.pkl")
+    if not _st.exists(path):
         raise ValueError(f"workflow {workflow_id!r} has no stored result; "
                          f"pass the DAG to resume execution")
-    with open(path, "rb") as f:
-        return pickle.load(f)["value"]
+    return pickle.loads(_st.get_bytes(path))["value"]
 
 
 def get_status(workflow_id: str) -> Optional[dict]:
-    path = os.path.join(_STORAGE, workflow_id, "result.pkl")
-    if not os.path.exists(path):
-        steps = _step_dir(workflow_id)
-        n = len(os.listdir(steps)) if os.path.isdir(steps) else 0
+    path = _st.join(_STORAGE, workflow_id, "result.pkl")
+    if not _st.exists(path):
+        n = len(_st.listdir(_step_dir(workflow_id)))
         return {"workflow_id": workflow_id, "status": "RUNNING" if n else None,
                 "steps_done": n}
-    with open(path, "rb") as f:
-        return pickle.load(f)["meta"]
+    return pickle.loads(_st.get_bytes(path))["meta"]
 
 
 def list_all() -> list[str]:
-    if not os.path.isdir(_STORAGE):
-        return []
-    return sorted(os.listdir(_STORAGE))
+    return sorted(_st.listdir(_STORAGE))

@@ -1,0 +1,157 @@
+"""Checkpoint atomicity under chaos (README "Checkpointing & storage"):
+transient sim:// write failures are retried with backoff; a severed
+backend or a worker killed mid-save_async never corrupts the last
+committed checkpoint — the partial upload stays invisible (no manifest)
+and is GC'd; a corrupt controller snapshot is quarantined, not
+crash-looped.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import storage
+from ray_tpu._private.rtconfig import CONFIG
+from ray_tpu.storage import StorageTransientError
+from ray_tpu.storage.sim import faults
+from ray_tpu.train import checkpoint as ck
+
+
+@pytest.fixture(autouse=True)
+def _clean_sim():
+    faults().clear()
+    yield
+    faults().clear()
+
+
+def _state(tag: float):
+    return {"params": {"w": np.full((64, 8), tag),
+                       "b": np.arange(8.0) + tag},
+            "step": int(tag)}
+
+
+def test_transient_write_failures_retried_with_backoff(tmp_path, monkeypatch):
+    monkeypatch.setitem(CONFIG._overrides, "ckpt_retry_base_s", 0.05)
+    root = "sim://" + str(tmp_path / "cks")
+    d = storage.join(root, "checkpoint_000001")
+    # 2nd put fails twice (the put itself, then its first retry), then heals.
+    faults().add_rule(op="put", after=1, times=2)
+    t0 = time.perf_counter()
+    h = ck.save_async(_state(1), d, step=1)
+    h.result(60)
+    elapsed = time.perf_counter() - t0
+    assert h.stats["retries"] == 2
+    assert faults().stats.get("put") == 2
+    assert elapsed >= 0.05 + 0.1  # exponential backoff actually slept
+    assert np.array_equal(ck.restore(d)["params"]["w"], np.full((64, 8), 1.0))
+
+
+def test_sever_mid_save_previous_commit_intact(tmp_path, monkeypatch):
+    """The backend 'partitions' partway through a save: the save fails
+    after its retry budget, the previous committed checkpoint still loads
+    bitwise, and the partial is GC'd."""
+    monkeypatch.setitem(CONFIG._overrides, "ckpt_retries", 1)
+    monkeypatch.setitem(CONFIG._overrides, "ckpt_retry_base_s", 0.01)
+    root = "sim://" + str(tmp_path / "cks")
+    d1 = storage.join(root, "checkpoint_000001")
+    d2 = storage.join(root, "checkpoint_000002")
+    ck.save_async(_state(1), d1, step=1).result(60)
+
+    # Everything on checkpoint 2 fails after its first 2 ops (the
+    # in-progress marker + one shard land, then the link goes down).
+    faults().add_rule(op="*", after=2, match=lambda p: "checkpoint_000002" in p)
+    h = ck.save_async(_state(2), d2, step=2)
+    with pytest.raises(StorageTransientError):
+        h.result(60)
+    faults().clear()
+
+    assert ck.load_manifest(d2) is None, "partial must never look committed"
+    assert ck.latest_checkpoint(root) == d1
+    st = ck.restore(d1)
+    assert np.array_equal(st["params"]["w"], np.full((64, 8), 1.0))
+    assert st["step"] == 1
+    # the partial shows up as such, then GC collects it
+    rows = ck.list_checkpoints(root)
+    assert [(r["name"], r["committed"]) for r in rows] == [
+        ("checkpoint_000001", True), ("checkpoint_000002", False)]
+    assert ck.gc_partials(root, grace_s=0) == [d2]
+    assert ck.restore(d1)["step"] == 1  # survivor untouched by GC
+
+
+@ray_tpu.remote
+class _Saver:
+    def save(self, d, tag, latency=0.0):
+        # Workers run on the head's propagated config snapshot, so the
+        # latency knob must go through _system_config, not env.
+        from ray_tpu._private.rtconfig import CONFIG
+
+        CONFIG.apply_system_config({"sim_storage_latency_s": latency})
+        state = {"params": {"w": np.full((64, 8), float(tag)),
+                            "b": np.arange(8.0) + float(tag)},
+                 "step": int(tag)}
+        ck.save(state, d, step=int(tag))
+        return True
+
+
+def test_kill_worker_mid_save_last_committed_loads(ray_start_2cpu, tmp_path):
+    """A train-style worker dies mid-save_async (SIGKILL, no cleanup):
+    the previous committed checkpoint loads intact and the orphaned
+    partial is GC'd."""
+    fs_root = str(tmp_path / "cks")
+    root = "sim://" + fs_root
+    d1 = storage.join(root, "checkpoint_000001")
+    d2 = storage.join(root, "checkpoint_000002")
+
+    saver = _Saver.remote()
+    assert ray_tpu.get(saver.save.remote(d1, 1), timeout=60)
+
+    # Slow every storage op in the saver's process, then kill it as soon
+    # as the save's first object (the in-progress marker) hits storage.
+    ref = saver.save.remote(d2, 2, latency=0.3)
+    marker = os.path.join(fs_root, "checkpoint_000002", "_inprogress_r0")
+    deadline = time.monotonic() + 30
+    while not os.path.exists(marker):
+        assert time.monotonic() < deadline, "save never started"
+        time.sleep(0.01)
+    ray_tpu.kill(saver)
+    with pytest.raises(Exception):
+        ray_tpu.get(ref, timeout=30)
+
+    assert ck.load_manifest(d2) is None, "killed save must not be committed"
+    st = ck.restore(d1)
+    assert np.array_equal(st["params"]["w"], np.full((64, 8), 1.0))
+    assert ck.latest_checkpoint(root) == d1
+    assert ck.gc_partials(root, grace_s=0) == [d2]
+    rows = ck.list_checkpoints(root)
+    assert [r["name"] for r in rows] == ["checkpoint_000001"]
+
+
+def test_corrupt_controller_snapshot_quarantined(tmp_path):
+    """A truncated/corrupt persisted controller snapshot must not
+    crash-loop startup: the head comes up fresh and the bad file is moved
+    aside with a .corrupt suffix."""
+    from tests.test_controller_ft import _free_port, _spawn_head
+
+    port = _free_port()
+    session_dir = str(tmp_path / "session")
+    persist_dir = str(tmp_path / "persist")
+    os.makedirs(session_dir, exist_ok=True)
+    os.makedirs(persist_dir, exist_ok=True)
+    bad = os.path.join(persist_dir, "controller_state.pkl")
+    with open(bad, "wb") as f:
+        f.write(b"\x80\x05 this is not a pickle")
+    head, info = _spawn_head(port, session_dir, persist_dir)
+    try:
+        assert os.path.exists(bad + ".corrupt"), "bad snapshot not quarantined"
+        assert not os.path.exists(bad)
+        # and the controller is actually serving
+        from ray_tpu._private import rpc
+
+        assert json.loads(json.dumps(info))["address"]
+    finally:
+        head.terminate()
+        head.wait(timeout=30)

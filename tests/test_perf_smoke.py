@@ -48,3 +48,17 @@ def test_bench_smoke_runs():
     assert dev > 1.5 * host, (
         f"device object plane ({dev} GB/s) does not beat the host store "
         f"path ({host} GB/s) by 1.5x")
+    # Checkpoint engine: saves must move real bytes, and async
+    # checkpointing must (a) stay off the step path (< 1.2x a
+    # checkpoint-free loop) and (b) hide the commit latency a sync save
+    # pays on the step (README "Checkpointing & storage").
+    assert rep["details"].get("checkpoint_save_gbps", 0) > 0, (
+        "checkpoint bench missing (see its stderr)")
+    overhead = rep["details"]["checkpoint_async_step_overhead"]
+    assert overhead < 1.2, (
+        f"async checkpointing costs {overhead}x on the step path")
+    async_s = rep["details"]["checkpoint_async_step_s"]
+    sync_s = rep["details"]["checkpoint_sync_step_s"]
+    assert async_s < sync_s, (
+        f"async step time ({async_s}s) does not beat sync save "
+        f"({sync_s}s) — commit latency is not hidden")

@@ -1,0 +1,199 @@
+"""Storage plane: URI parsing, backend semantics (atomic put, rename
+commit, listdir), the pluggable registry, and sim:// fault injection.
+
+These are the contracts the checkpoint engine's manifest-last protocol
+builds on (README "Checkpointing & storage").
+"""
+
+import os
+import threading
+
+import pytest
+
+from ray_tpu import storage
+from ray_tpu.storage import (
+    StorageError,
+    StorageNotFoundError,
+    StorageTransientError,
+)
+from ray_tpu.storage.mem import MemBackend
+from ray_tpu.storage.sim import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_sim_and_mem():
+    faults().clear()
+    MemBackend.clear_all()
+    yield
+    faults().clear()
+    MemBackend.clear_all()
+
+
+# ------------------------------------------------------------- uri parsing
+def test_parse_uri_schemes():
+    assert storage.parse_uri("/a/b") == ("local", "/a/b")
+    assert storage.parse_uri("local:///a/b") == ("local", "/a/b")
+    assert storage.parse_uri("file:///a/b") == ("local", "/a/b")
+    assert storage.parse_uri("sim:///a/b") == ("sim", "/a/b")
+    assert storage.parse_uri("mem://bucket/k") == ("mem", "bucket/k")
+
+
+def test_join_keeps_bare_paths_bare():
+    assert storage.join("/a", "b", "c") == "/a/b/c"
+    assert storage.join("mem://x", "y") == "mem://x/y"
+    assert storage.join("sim:///a/", "/b") == "sim:///a/b"
+
+
+def test_is_local_and_local_path():
+    assert storage.is_local("/a/b") and storage.local_path("/a/b") == "/a/b"
+    assert storage.is_local("local:///a") and storage.local_path("local:///a") == "/a"
+    # sim is fs-backed but must NOT be treated as local: direct fs access
+    # would bypass fault injection.
+    assert not storage.is_local("sim:///a")
+    assert storage.local_path("mem://b/k") is None
+
+
+def test_unknown_scheme_and_registration():
+    with pytest.raises(StorageError):
+        storage.get_backend("gs://bucket/x")
+    storage.register_backend("gs", MemBackend)
+    try:
+        be, path = storage.get_backend("gs://bucket/x")
+        assert isinstance(be, MemBackend) and path == "bucket/x"
+    finally:
+        storage.backend._REGISTRY.pop("gs", None)
+        storage.backend._INSTANCES.pop("gs", None)
+
+
+# --------------------------------------------------------------- backends
+@pytest.fixture(params=["local", "mem", "sim"])
+def root(request, tmp_path):
+    if request.param == "local":
+        return str(tmp_path / "store")
+    if request.param == "sim":
+        return "sim://" + str(tmp_path / "simstore")
+    return "mem://test-root"
+
+
+def test_backend_put_get_list_delete_rename(root):
+    a = storage.join(root, "dir", "a.bin")
+    storage.put(a, b"hello")
+    assert storage.exists(a)
+    assert storage.get_bytes(a) == b"hello"
+    assert storage.size(a) == 5
+    # streamed parts
+    b = storage.join(root, "dir", "b.bin")
+    storage.put(b, [b"he", bytearray(b"l"), memoryview(b"lo")])
+    assert storage.get_bytes(b) == b"hello"
+    assert sorted(storage.listdir(storage.join(root, "dir"))) == ["a.bin", "b.bin"]
+    # rename is the commit primitive
+    c = storage.join(root, "dir", "MANIFEST.json")
+    storage.rename(b, c)
+    assert not storage.exists(b) and storage.get_bytes(c) == b"hello"
+    assert storage.delete(a) is True
+    assert storage.delete(a) is False
+    storage.delete_prefix(storage.join(root, "dir"))
+    assert storage.listdir(storage.join(root, "dir")) == []
+
+
+def test_backend_get_missing_raises(root):
+    with pytest.raises(StorageNotFoundError):
+        storage.get_bytes(storage.join(root, "nope.bin"))
+    with pytest.raises(StorageNotFoundError):
+        storage.size(storage.join(root, "nope.bin"))
+
+
+def test_backend_put_overwrite_atomic(root):
+    p = storage.join(root, "x.bin")
+    storage.put(p, b"one")
+    storage.put(p, b"two")
+    assert storage.get_bytes(p) == b"two"
+
+
+def test_local_put_is_atomic_no_partial_visible(tmp_path):
+    """A concurrent reader either sees the full old or full new object —
+    never a torn write (tmp + os.replace)."""
+    p = str(tmp_path / "obj.bin")
+    storage.put(p, b"A" * 1_000_000)
+    stop = threading.Event()
+    bad = []
+
+    def reader():
+        while not stop.is_set():
+            data = storage.get_bytes(p)
+            if len(data) != 1_000_000 or data[0:1] not in (b"A", b"B"):
+                bad.append(len(data))
+            if data[0:1] == b"B" and data[-1:] != b"B":
+                bad.append("torn")
+
+    t = threading.Thread(target=reader)
+    t.start()
+    for _ in range(20):
+        storage.put(p, b"B" * 1_000_000)
+        storage.put(p, b"A" * 1_000_000)
+    stop.set()
+    t.join()
+    assert not bad, bad
+
+
+def test_mem_rename_prefix():
+    storage.put("mem://r/src/a", b"1")
+    storage.put("mem://r/src/sub/b", b"2")
+    storage.rename("mem://r/src", "mem://r/dst")
+    assert storage.get_bytes("mem://r/dst/a") == b"1"
+    assert storage.get_bytes("mem://r/dst/sub/b") == b"2"
+    assert storage.listdir("mem://r/src") == []
+
+
+# ------------------------------------------------------------ sim chaos
+def test_sim_injected_transient_failure(tmp_path):
+    root = "sim://" + str(tmp_path / "s")
+    faults().add_rule(op="put", after=1, times=1)
+    storage.put(storage.join(root, "ok.bin"), b"x")  # admitted (after=1)
+    with pytest.raises(StorageTransientError):
+        storage.put(storage.join(root, "fail.bin"), b"x")
+    # schedule exhausted (times=1): next put goes through
+    storage.put(storage.join(root, "ok2.bin"), b"x")
+    assert faults().stats.get("put") == 1
+
+
+def test_sim_fatal_failure(tmp_path):
+    root = "sim://" + str(tmp_path / "s")
+    faults().add_rule(op="put", error="fatal", times=1)
+    with pytest.raises(StorageError) as ei:
+        storage.put(storage.join(root, "f.bin"), b"x")
+    assert not isinstance(ei.value, StorageTransientError)
+
+
+def test_sim_sever_and_restore(tmp_path):
+    root = "sim://" + str(tmp_path / "s")
+    storage.put(storage.join(root, "a.bin"), b"x")
+    faults().sever()
+    with pytest.raises(StorageTransientError):
+        storage.get_bytes(storage.join(root, "a.bin"))
+    with pytest.raises(StorageTransientError):
+        storage.put(storage.join(root, "b.bin"), b"x")
+    faults().restore()
+    assert storage.get_bytes(storage.join(root, "a.bin")) == b"x"
+
+
+def test_sim_latency_knob(tmp_path, monkeypatch):
+    import time
+
+    # Through _system_config, not env: once a cluster has started in this
+    # process, the propagated config snapshot shadows env overrides.
+    from ray_tpu._private.rtconfig import CONFIG
+
+    monkeypatch.setitem(CONFIG._overrides, "sim_storage_latency_s", 0.05)
+    root = "sim://" + str(tmp_path / "s")
+    t0 = time.perf_counter()
+    storage.put(storage.join(root, "a.bin"), b"x")
+    assert time.perf_counter() - t0 >= 0.05
+
+
+def test_sim_is_fs_backed_for_forensics(tmp_path):
+    """Objects written via sim:// land on the real fs — a process killed
+    mid-save leaves partial files that GC tests can find."""
+    root = str(tmp_path / "s")
+    storage.put("sim://" + os.path.join(root, "a.bin"), b"x")
+    assert os.path.exists(os.path.join(root, "a.bin"))

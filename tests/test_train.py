@@ -121,6 +121,43 @@ def test_jax_trainer_failure_restart(ray_start_4cpu, tmp_path):
     assert 5 in steps and steps.count(2) >= 1  # progressed past the crash
 
 
+def test_sharded_state_checkpoint_via_report(ray_start_4cpu, tmp_path):
+    """train.report(checkpoint=<state pytree>) rides the async sharded
+    engine: EVERY rank calls report (rank 0 commits after all ranks'
+    shard metadata lands in storage), the controller only learns of
+    COMMITTED checkpoints, and the result checkpoint restores bitwise —
+    including onto a different world size (here: the driver, world=1)."""
+    import numpy as np
+
+    def loop(config):
+        import numpy as np
+
+        import ray_tpu.train as train
+
+        ctx = train.get_context()
+        rank = ctx.get_world_rank()
+        for step in range(3):
+            state = {"params": {"w": np.full((8, 4), float(step))},
+                     "step": step, "rank_of_writer": 0}
+            train.report({"step": step, "rank": rank}, checkpoint=state)
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(num_workers=2),
+        run_config=RunConfig(name="sharded_ck", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert result.checkpoint is not None
+    from ray_tpu.train import checkpoint as ckpt_mod
+
+    man = ckpt_mod.load_manifest(result.checkpoint.path)
+    assert man is not None and man["world_size"] == 2
+    st = ckpt_mod.restore(result.checkpoint.path)
+    assert np.array_equal(st["params"]["w"], np.full((8, 4), 2.0))
+    assert st["step"] == 2
+
+
 def test_jax_trainer_user_error_no_retry(ray_start_2cpu, tmp_path):
     def bad_loop(config):
         raise ValueError("intentional")

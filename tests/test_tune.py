@@ -179,6 +179,56 @@ def test_pbt_exploits_good_trials(ray_start_4cpu, tmp_path):
     assert finals[0] > 10.0, finals
 
 
+def test_pbt_clone_pin_protects_donor_checkpoint(tmp_path):
+    """The PBT checkpoint-sharing hazard: a clone restores from
+    `donor.checkpoint_path`, so the donor's retention/GC must not collect
+    that dir while the clone still references it. The controller pins the
+    restore source on exploit; the pin defeats retention until the clone
+    has a checkpoint of its own (or stops)."""
+    import numpy as np
+
+    from ray_tpu import storage
+    from ray_tpu.train import checkpoint as ckpt_mod
+    from ray_tpu.tune.trial import Trial
+    from ray_tpu.tune.tuner import TuneController
+
+    ctl = TuneController.__new__(TuneController)  # pin logic only
+    donor = Trial({"rate": 5.0}, str(tmp_path / "trial_donor"))
+    clone = Trial({"rate": 0.1}, str(tmp_path / "trial_clone"))
+    # donor has one committed checkpoint; session-side retention keeps 1
+    ck1 = storage.join(donor.trial_dir, "checkpoint_000001")
+    ckpt_mod.upload_directory(_make_dir(tmp_path, "payload-1"), ck1, step=1)
+    donor.checkpoint_path = ck1
+
+    ctl._pin_restore_source(clone, donor.checkpoint_path)
+    assert clone.restore_from == ck1 and clone.pinned_source == ck1
+
+    # donor keeps training: a newer checkpoint + keep-last-1 retention
+    # (what the donor's trial session runs under RT_CKPT_KEEP=1)
+    ck2 = storage.join(donor.trial_dir, "checkpoint_000002")
+    ckpt_mod.upload_directory(_make_dir(tmp_path, "payload-2"), ck2, step=2)
+    deleted = ckpt_mod.retention(donor.trial_dir, keep=1)
+    assert deleted == []  # ck1 pinned by the clone -> survives
+    assert storage.exists(storage.join(ck1, "state.txt"))
+
+    # the clone writes its own checkpoint -> controller releases the pin
+    clone.checkpoint_path = storage.join(clone.trial_dir,
+                                         "checkpoint_000001")
+    ctl._release_restore_pin(clone)
+    assert ckpt_mod.retention(donor.trial_dir, keep=1) == [ck1]
+    assert not storage.exists(ck1)
+    assert storage.exists(storage.join(ck2, "state.txt"))
+
+
+def _make_dir(tmp_path, payload: str) -> str:
+    import uuid
+
+    d = tmp_path / f"src_{uuid.uuid4().hex[:6]}"
+    d.mkdir()
+    (d / "state.txt").write_text(payload)
+    return str(d)
+
+
 def test_trainer_in_tuner(ray_start_4cpu, tmp_path):
     from ray_tpu.train import JaxTrainer, ScalingConfig
 

@@ -50,6 +50,36 @@ def test_workflow_run_and_resume(ray_start_2cpu, tmp_path):
     assert marker.read_text() == "4"
 
 
+def test_workflow_memoizes_over_storage_uri(ray_start_2cpu, tmp_path):
+    """Workflow storage is the pluggable storage plane: a mem:// root
+    memoizes steps exactly like the filesystem default (README
+    "Checkpointing & storage")."""
+    from ray_tpu import workflow
+    from ray_tpu.storage.mem import MemBackend
+
+    MemBackend.clear_all()
+    workflow.init("mem://wfstore")
+    try:
+        marker = tmp_path / "exec_count"
+        marker.write_text("0")
+
+        @ray_tpu.remote
+        def bump(x, marker_path):
+            p = __import__("pathlib").Path(marker_path)
+            p.write_text(str(int(p.read_text()) + 1))
+            return x + 1
+
+        dag = bump.bind(41, str(marker))
+        assert workflow.run(dag, workflow_id="wfm") == 42
+        assert workflow.run(dag, workflow_id="wfm") == 42
+        assert marker.read_text() == "1"  # memoized in mem://
+        assert "wfm" in workflow.list_all()
+        assert workflow.get_status("wfm")["status"] == "SUCCESSFUL"
+    finally:
+        workflow.init(str(tmp_path / "wf_default"))  # restore module state
+        MemBackend.clear_all()
+
+
 def test_channel_roundtrip_and_latency(ray_start_2cpu):
     from ray_tpu.experimental.channel import Channel
 
