@@ -193,6 +193,15 @@ class Controller:
         # notified in batched `objects_ready` frames (one per owner per
         # event-loop burst) instead of one push per oid.
         self._ready_bufs: dict[str, list] = {}
+        # Stall-detection plane (README "Stall detection & watchdogs"):
+        # ring of StallReports forwarded by node agents (worker watchdogs +
+        # agent backstops) and train controllers; served by list_stalls /
+        # `ray-tpu stalls`, counted into rt_stalls_total{stage}.
+        self.stalls: deque = deque(maxlen=512)
+        # node_id -> (task_id -> progress-silence seconds, received-at):
+        # per-task beacon ages riding agent heartbeats, so task_status can
+        # answer "how long has the producer been silent".
+        self._task_beacons: dict[str, tuple] = {}
         # node_id -> latest minted incarnation. Survives the NodeState
         # (incremented across SUSPECT->DEAD->rejoin), so a zombie agent
         # from ANY previous life is fenced, not just the last one.
@@ -735,6 +744,11 @@ class Controller:
             node.last_beat = time.monotonic()
             if "shm_used" in a:
                 node.shm_used = a["shm_used"]
+            beacons = a.get("beacons")
+            if beacons:
+                self._task_beacons[a["node_id"]] = (beacons, time.monotonic())
+            else:
+                self._task_beacons.pop(a.get("node_id"), None)
 
     # ---------------------------------------------------------- scheduling
     def _kick(self):
@@ -1743,6 +1757,74 @@ class Controller:
     async def _p_task_events(self, conn, a):
         self.task_events.extend(a["events"])
 
+    # ------------------------------------------------------ stall detection
+    async def _p_stall_report(self, conn, a):
+        """One escalation-ladder stage observed somewhere in the cluster
+        (worker watchdog via its node agent, agent backstop, or a train
+        controller's group-stall policy). Aggregated into the stalls ring
+        (util.state.list_stalls / `ray-tpu stalls`) and the
+        rt_stalls_total{stage} counter."""
+        if conn is not None and conn.meta.get("kind") == "node" \
+                and self._fenced_node(conn, a) is None:
+            return  # stale-incarnation zombie
+        report = dict(a.get("report") or {})
+        report.setdefault("node_id", a.get("node_id"))
+        report["received"] = time.time()
+        # Bound what the ring keeps per row: the full flight dump lives in
+        # storage (report["flight_path"]); the ring is for triage listing.
+        evs = report.get("events")
+        if isinstance(evs, list) and len(evs) > 16:
+            report["events"] = evs[-16:]
+        stacks = report.get("stacks")
+        if isinstance(stacks, str) and len(stacks) > 4000:
+            report["stacks"] = stacks[-4000:]
+        self.stalls.append(report)
+        await self._p_metrics_report(None, {"records": [{
+            "kind": "counter", "name": "rt_stalls_total",
+            "desc": "stall escalations (warn/dump/kill stages observed)",
+            "tags": {"stage": str(report.get("stage") or "?")},
+            "value": 1.0}]})
+
+    async def _h_list_stalls(self, conn, a):
+        limit = int(a.get("limit", 1000))
+        return {"stalls": list(self.stalls)[-limit:]}
+
+    async def _h_task_status(self, conn, a):
+        """Best-effort status of ONE task — the enrichment behind
+        GetTimeoutError: queued/running, where, and seconds since its last
+        progress beacon (when the stall watchdog is beaconing)."""
+        tid = a["task_id"]
+        out = {"found": False, "state": None, "name": None, "attempt": None,
+               "node_id": None, "worker_id": None, "beacon_age_s": None}
+        now = time.monotonic()
+        for nid, (beacons, ts) in self._task_beacons.items():
+            age = beacons.get(tid)
+            if age is not None:
+                out.update(found=True, state="running", node_id=nid,
+                           beacon_age_s=round(age + (now - ts), 3))
+                break
+        info = self.dispatched.get(tid)
+        if info is not None:
+            out.update(found=True, state=out["state"] or "running",
+                       node_id=info["node_id"], worker_id=info["worker_id"],
+                       name=info["spec"].name, attempt=info["spec"].attempt)
+            return out
+        for spec in self.pending:
+            if spec.task_id == tid:
+                out.update(found=True, state="queued", name=spec.name,
+                           attempt=spec.attempt)
+                return out
+        if not out["found"]:
+            for ev in reversed(self.task_events):
+                if ev["task_id"] == tid:
+                    out.update(found=True,
+                               state="finished" if ev["ok"] else "failed",
+                               name=ev["name"], attempt=ev["attempt"],
+                               node_id=ev["node_id"],
+                               worker_id=ev["worker_id"])
+                    break
+        return out
+
     async def _h_get_task_events(self, conn, a):
         limit = int(a.get("limit", 100_000))
         evs = list(self.task_events)
@@ -2432,6 +2514,7 @@ class Controller:
         node.liveness = "DEAD"
         self.node_conns.pop(nid, None)
         self._drop_node_pool(nid)
+        self._task_beacons.pop(nid, None)
         self._reconciled_busy = {
             t: (n, r) for t, (n, r) in self._reconciled_busy.items()
             if n != nid}

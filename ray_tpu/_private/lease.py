@@ -495,6 +495,13 @@ class LeaseManager:
                     "type": "OutOfMemoryError",
                     "message": f"leased worker {lease.worker_id[:8]} was "
                                f"killed by the node memory monitor"})
+            elif lease.fail_cause == "stall":
+                self._fail_spec(spec, {
+                    "type": "WorkerCrashedError",
+                    "message": f"leased worker {lease.worker_id[:8]} was "
+                               f"killed by the stall watchdog (no progress "
+                               f"past RT_STALL_KILL_S; see "
+                               f"util.state.list_stalls())"})
             else:
                 self._fail_spec(spec, {
                     "type": "WorkerCrashedError",
@@ -512,6 +519,35 @@ class LeaseManager:
             self.w.submit_specs_via_controller(failover)
         if lease.cls.queue:
             self._pump(lease.cls)
+
+    def task_status(self, task_id: str) -> dict | None:
+        """Best-effort status of a task this owner submitted on the direct
+        path (GetTimeoutError enrichment). Read-only scan from the caller's
+        thread; deliberately racy — diagnostics must not take loop-side
+        locks or block on the IO thread."""
+        try:
+            with self._lock:
+                for cls in self.classes.values():
+                    for spec in cls.queue:
+                        if spec.task_id == task_id:
+                            return {"found": True, "state": "queued",
+                                    "via": "direct", "name": spec.name,
+                                    "attempt": spec.attempt,
+                                    "node_id": None, "worker_id": None,
+                                    "beacon_age_s": None}
+            for lease in list(self._by_id.values()):
+                spec = lease.inflight.get(task_id)
+                if spec is None:
+                    continue
+                sent = all(s.task_id != task_id for s in list(lease.buf))
+                return {"found": True,
+                        "state": "running" if sent else "queued",
+                        "via": "direct", "name": spec.name,
+                        "attempt": spec.attempt, "node_id": lease.node_id,
+                        "worker_id": lease.worker_id, "beacon_age_s": None}
+        except Exception:
+            pass
+        return None
 
     def on_lease_invalid(self, lease_id: str, cause: str | None = None):
         lease = self._by_id.get(lease_id)

@@ -31,7 +31,8 @@ logger = logging.getLogger(__name__)
 class _WorkerSlot:
     __slots__ = ("worker_id", "proc", "conn", "state", "task_id", "actor_id", "address",
                  "registered", "dedicated", "idle_since", "assigned_at",
-                 "held_resources", "device_pinned")
+                 "held_resources", "device_pinned",
+                 "beacon_task", "beacon_at", "beacon_silence")
 
     def __init__(self, worker_id: str, proc, dedicated: bool = False):
         self.worker_id = worker_id
@@ -53,6 +54,14 @@ class _WorkerSlot:
         # idle pool worker is still the STORAGE for those objects, so the
         # idle reaper must not kill it (README "Device objects").
         self.device_pinned = False
+        # Stall-watchdog beacons (README "Stall detection & watchdogs"):
+        # the executing task the worker last beaconed about, when, and its
+        # self-reported progress silence. Beacons STOPPING while a task
+        # runs trips the agent-side backstop (worker wedged in native code
+        # can't run its own monitor thread).
+        self.beacon_task: Optional[str] = None
+        self.beacon_at: float = 0.0
+        self.beacon_silence: float = 0.0
 
 
 class NodeAgent:
@@ -361,6 +370,98 @@ class NodeAgent:
                 last = size
             return {"found": False, "stacks": "worker did not dump in time"}
 
+    # ------------------------------------------------- stall escalation
+    async def _handle_stall_report(self, report: dict):
+        """One escalation stage from a worker's watchdog (or the backstop
+        below). warn: forward only. dump: capture the worker's live thread
+        stacks through the SAME per-pid dump path /api/stacks uses (one
+        implementation, one per-pid lock) and persist the whole report
+        through the storage plane under <flight_dir>/. kill: all of that,
+        then fell the worker — the death rides the ordinary worker_died /
+        lease-failover machinery, so the stalled attempt retries instead of
+        hanging its owner's get() forever."""
+        stage = report.get("stage")
+        wid = report.get("worker_id")
+        slot = self.workers.get(wid) if wid else None
+        if stage in ("dump", "kill"):
+            try:
+                stacks = await self._worker_stacks(wid)
+                report["stacks"] = (stacks.get("stacks")
+                                    if stacks.get("found") else None)
+            except Exception:
+                report["stacks"] = None
+            await self._persist_flight_dump(report)
+        try:
+            await self.controller.push(
+                "stall_report", report=report, node_id=self.node_id,
+                incarnation=self.incarnation)
+        except Exception:
+            pass
+        if stage == "kill" and slot is not None and slot.proc.poll() is None \
+                and slot.state != "dead":
+            # Re-validate against the worker's LATEST beacon before the
+            # kill: the stack capture + flight dump above took real time,
+            # and a task that finished right at the threshold may have
+            # handed the worker to NEW work. Beacons keep naming the
+            # stalest executing task, so a mismatch means the worker moved
+            # on — killing it now would fail an innocent attempt.
+            # (Backstop reports skip this: their whole premise is that
+            # beacons stopped.)
+            expected = report.get("task_id")
+            if (not report.get("backstop") and expected is not None
+                    and slot.beacon_task != expected):
+                logger.info(
+                    "stall kill aborted: worker %s no longer executing "
+                    "task %s (moved on)", wid[:8], str(expected)[:12])
+                return
+            reason = (f"stalled: task {report.get('name')!r} made no "
+                      f"progress for {report.get('silence_s')}s "
+                      f"(watchdog kill escalation)")
+            logger.warning("stall watchdog: killing worker %s — %s",
+                           wid[:8], reason)
+            # Report BEFORE terminating (the OOM-kill pattern) so owners
+            # see an attributed death, then kill; retries ride the
+            # existing paths from here.
+            await self._worker_exited(slot, reason, cause="stall")
+            self._kill_slot(slot)
+
+    async def _persist_flight_dump(self, report: dict):
+        """Write the StallReport (flight-recorder ring + stacks included)
+        through the PR 8 storage backend so it survives the process. Train
+        runs route this under <run>/flight/ via RT_STALL_FLIGHT_DIR."""
+        import json as _json
+
+        try:
+            from ray_tpu import storage
+
+            flight_dir = report.get("flight_dir") or os.path.join(
+                CONFIG.session_dir, self.session_id, "flight")
+            name = (f"{int((report.get('time') or time.time()) * 1000)}"
+                    f"_{report.get('pid')}_{report.get('stage')}.json")
+            path = storage.join(flight_dir, name)
+            blob = _json.dumps(report, default=str).encode()
+
+            def _put():
+                storage.makedirs(flight_dir)
+                storage.put(path, blob)
+
+            await asyncio.to_thread(_put)
+            report["flight_path"] = path
+        except Exception:
+            logger.exception("stall watchdog: flight dump failed")
+
+    def _beacon_ages(self) -> dict | None:
+        """task_id -> seconds since the executing worker's last progress,
+        shipped with heartbeats so `get(timeout=)` failures and
+        `task_status` can name how long the producer has been silent."""
+        now = time.monotonic()
+        out = {}
+        for slot in self.workers.values():
+            if slot.beacon_task is not None and slot.beacon_at:
+                out[slot.beacon_task] = round(
+                    slot.beacon_silence + (now - slot.beacon_at), 3)
+        return out or None
+
     # ------------------------------------------------------------- jobs
     # Reference: the job supervisor runs the entrypoint as a shell
     # subprocess with RAY_ADDRESS injected and streams its output to a
@@ -486,10 +587,13 @@ class NodeAgent:
         while True:
             await asyncio.sleep(CONFIG.heartbeat_interval_s)
             try:
-                await self.controller.push(
-                    "heartbeat", node_id=self.node_id,
-                    incarnation=self.incarnation,
-                    shm_used=self.store.shm_dir_usage())
+                beat = dict(node_id=self.node_id,
+                            incarnation=self.incarnation,
+                            shm_used=self.store.shm_dir_usage())
+                beacons = self._beacon_ages()
+                if beacons:  # frame unchanged when the watchdog is idle
+                    beat["beacons"] = beacons
+                await self.controller.push("heartbeat", **beat)
             except Exception:
                 continue
 
@@ -555,6 +659,14 @@ class NodeAgent:
             slot = self.workers.get(a["worker_id"])
             if slot is not None:
                 slot.device_pinned = bool(a.get("pinned"))
+        elif method == "watchdog_beacon":
+            slot = self.workers.get(a["worker_id"])
+            if slot is not None:
+                slot.beacon_task = a.get("task_id")
+                slot.beacon_at = time.monotonic()
+                slot.beacon_silence = float(a.get("silence") or 0.0)
+        elif method == "stall_report":
+            asyncio.ensure_future(self._handle_stall_report(a["report"]))
 
     def _on_worker_conn_close(self, conn):
         wid = conn.meta.get("worker_id")
@@ -787,6 +899,46 @@ class NodeAgent:
                 for tid, rec in list(self._direct_tasks.items()):
                     if rec.get("state") == "done" and rec["expires"] < now:
                         self._direct_tasks.pop(tid, None)
+            # Stall backstop: a worker whose beacons STOPPED mid-task is too
+            # wedged to run its own monitor thread (native code holding the
+            # GIL) — its self-reported kill stage will never arrive, so the
+            # agent synthesizes it once the beacon goes stale past the kill
+            # threshold.
+            kill_s = CONFIG.stall_kill_s
+            if kill_s and kill_s > 0:
+                interval = max(0.05, CONFIG.stall_beacon_interval_s)
+                now = time.monotonic()
+                for slot in list(self.workers.values()):
+                    # Beacons flow every tick from ANY armed worker, task or
+                    # no task — so the trigger is the beacon STREAM going
+                    # stale, not the task it names (a task that wedges in
+                    # native code before its first named beacon leaves
+                    # beacon_task None forever; the worker is just as dead).
+                    # beacon_at == 0 means the worker never armed a
+                    # watchdog (old build / just spawned): nothing to judge.
+                    if (not slot.beacon_at
+                            or slot.state in ("dead", "starting")
+                            or slot.proc.poll() is not None):
+                        continue
+                    stale = now - slot.beacon_at
+                    if stale <= kill_s + 5 * interval:
+                        continue
+                    report = {
+                        "scope": "task", "stage": "kill", "backstop": True,
+                        "task_id": slot.beacon_task or slot.task_id,
+                        "name": None, "attempt": None, "kind": None,
+                        "worker_id": slot.worker_id,
+                        "node_id": self.node_id, "pid": slot.proc.pid,
+                        "silence_s": round(slot.beacon_silence + stale, 3),
+                        "time": time.time(),
+                        "reason": (f"progress beacons stopped for "
+                                   f"{stale:.1f}s (watchdog starved — "
+                                   f"worker wedged in native code?)"),
+                        "events": [], "flight_dir": None,
+                    }
+                    slot.beacon_at = 0.0  # escalate once
+                    slot.beacon_task = None
+                    await self._handle_stall_report(report)
             keep = CONFIG.idle_worker_keep_s
             if keep > 0:
                 # Workers still pinning device objects are the storage for

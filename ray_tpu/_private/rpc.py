@@ -61,16 +61,20 @@ class FaultRule:
     the schedule is deterministic): the first `after` matching frames pass
     untouched, the next `times` (None = all) get `action` applied.
 
-    Actions: "drop" (frame vanishes), "delay" (frame waits `delay_s`),
-    "dup" (frame is delivered twice), "sever" (the connection is closed as
-    if the TCP link reset — both sides observe a normal close)."""
+    Actions: "drop" (frame vanishes; LATER frames still flow), "delay"
+    (frame waits `delay_s`), "dup" (frame is delivered twice), "sever" (the
+    connection is closed as if the TCP link reset — both sides observe a
+    normal close), "hang" (the matched frame — and, per FIFO link
+    semantics, everything behind it — is held FOREVER while the socket
+    stays healthy: the silent-stall chaos primitive; neither side observes
+    a close, calls never resolve)."""
 
     __slots__ = ("label", "action", "direction", "methods", "after", "times",
                  "delay_s", "match", "hits", "applied")
 
     def __init__(self, label, action, direction="both", methods=None,
                  after=0, times=None, delay_s=0.0, match=None):
-        assert action in ("drop", "delay", "dup", "sever"), action
+        assert action in ("drop", "delay", "dup", "sever", "hang"), action
         assert direction in ("send", "recv", "both"), direction
         self.label = label
         self.action = action
@@ -213,6 +217,25 @@ if _os.environ.get("RT_FAULT_INJECTION", "").lower() in ("1", "true", "yes"):
     enable_fault_injection()
 
 
+# ----------------------------------------------------------- flight recorder
+# Frame-level hook for the stall watchdog's flight recorder (see
+# _private/watchdog.py): records "rpc_send"/"rpc_recv" events with the frame
+# method. None (the default) keeps the hot path at exactly one module-global
+# check per frame — the same zero-cost-when-off pattern as _INJECTOR.
+_FLIGHT = None
+
+
+def set_flight_hook(fn) -> None:
+    global _FLIGHT
+    _FLIGHT = fn
+
+
+async def _hang_forever():
+    """Park this coroutine permanently (injected 'hang': the frame — and the
+    FIFO stream behind it — never moves, but the socket stays open)."""
+    await asyncio.Event().wait()
+
+
 class RpcError(Exception):
     pass
 
@@ -326,6 +349,8 @@ class Connection:
         # kills the connection (frames already buffered may be lost with it,
         # like a TCP reset).
         repeat, delay = 1, 0.0
+        if _FLIGHT is not None:
+            _FLIGHT("rpc_send", msg.get("m") or msg["k"])
         if _INJECTOR is not None:
             rule = _INJECTOR.pick(self, "send", msg)
             if rule is not None:
@@ -333,6 +358,11 @@ class Connection:
                     return
                 if rule.action == "delay":
                     delay = rule.delay_s
+                elif rule.action == "hang":
+                    # Infinite delay, NOT a close: the frame (and the FIFO
+                    # stream behind it) wedges while the socket stays
+                    # healthy — the silent-stall primitive.
+                    delay = float("inf")
                 elif rule.action == "dup":
                     repeat = 2
                 elif rule.action == "sever":
@@ -345,6 +375,8 @@ class Connection:
         if not self._coalesce:
             # Legacy path (RT_RPC_COALESCE=0): one drain per frame.
             async with self._wlock:
+                if delay == float("inf"):
+                    await _hang_forever()
                 if delay:
                     # Sleep INSIDE the write lock: a delayed frame must hold
                     # up younger frames like a slow link would —
@@ -401,12 +433,16 @@ class Connection:
                     if type(item) is float:
                         # Injected delay marker: flush everything older,
                         # then hold the line — younger frames wait behind
-                        # the delayed one like on a slow link.
+                        # the delayed one like on a slow link. An infinite
+                        # marker (injected 'hang') parks the flusher for
+                        # good with the connection still open.
                         if small:
                             w.write(small[0] if len(small) == 1
                                     else b"".join(small))
                             small, small_n = [], 0
                         await w.drain()
+                        if item == float("inf"):
+                            await _hang_forever()
                         await asyncio.sleep(item)
                         continue
                     if len(item) <= self._wjoin:
@@ -545,11 +581,17 @@ class Connection:
         try:
             while True:
                 msg = await _read_msg(self.reader)
+                if _FLIGHT is not None:
+                    _FLIGHT("rpc_recv", msg.get("m") or msg["k"])
                 if _INJECTOR is not None:
                     rule = _INJECTOR.pick(self, "recv", msg)
                     if rule is not None:
                         if rule.action == "drop":
                             continue
+                        if rule.action == "hang":
+                            # Hold the read loop (and every later frame on
+                            # this FIFO link) forever; the socket stays open.
+                            await _hang_forever()
                         if rule.action == "delay":
                             await asyncio.sleep(rule.delay_s)
                         elif rule.action == "sever":
@@ -772,6 +814,10 @@ class LocalConnection:
         self.closed = False
         self.meta: dict = {}
         self.label: Optional[str] = None  # fault-injection connection class
+        # Injected 'hang': once set, every later message is swallowed
+        # silently (it is "in the pipe" behind the held frame) while the
+        # link still looks healthy — calls simply never resolve.
+        self._hung = False
         if _INJECTOR is not None:
             _INJECTOR.track(self)
 
@@ -784,6 +830,10 @@ class LocalConnection:
         peer = self.peer
         if peer is None or peer.closed:
             raise ConnectionClosed("local peer went away")
+        if self._hung:
+            return  # wedged behind a held frame; link still "healthy"
+        if _FLIGHT is not None:
+            _FLIGHT("rpc_send", method)
         if _INJECTOR is not None:
             # The in-process transport has no frames; model the message
             # itself as one (send direction only — there is no reader side).
@@ -801,6 +851,9 @@ class LocalConnection:
                     self._close_both()
                     raise ConnectionClosed(
                         "fault injection: connection severed")
+                if rule.action == "hang":
+                    self._hung = True
+                    return  # this frame and everything after it wedge
                 if rule.action == "delay":
                     peer.loop.call_soon_threadsafe(
                         peer.loop.call_later, rule.delay_s, peer._dispatch,

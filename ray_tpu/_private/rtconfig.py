@@ -154,6 +154,32 @@ _flag("ckpt_partial_grace_s", float, 600.0)
 _flag("sim_storage_latency_s", float, 0.0)
 _flag("sim_storage_gbps", float, 0.0)
 _flag("sim_storage_severed", bool, False)
+# --- stall detection & flight recorder (README "Stall detection") ----------
+# Escalation ladder thresholds, seconds of per-task progress silence before
+# each stage fires: warn (StallReport only), dump (+ stack capture + flight
+# dump through the storage plane), kill (+ the node agent fells the worker
+# so the attempt fails over through the ordinary retry path). 0/unset
+# disables that stage; with ALL stages off the watchdog thread never starts
+# and nothing beacons — byte-identical to a watchdog-free build.
+_flag("stall_warn_s", float, 0.0)
+_flag("stall_dump_s", float, 0.0)
+_flag("stall_kill_s", float, 0.0)
+# Monitor/beacon cadence: the watchdog wakes (and beacons the node agent)
+# this often while a task executes. The agent's backstop treats beacons
+# STOPPING as the stall signal for workers too wedged to self-report.
+_flag("stall_beacon_interval_s", float, 0.5)
+# Flight recorder ring size (recent runtime events dumped into each
+# StallReport); 0 disables recording entirely.
+_flag("flight_recorder_events", int, 256)
+# Storage-plane URI escalation dumps are written under (any backend:
+# local://, mem://, sim://, bare path); "" = <session_dir>/<session>/flight.
+# Train runs point their workers at <run>/flight via RT_STALL_FLIGHT_DIR.
+_flag("stall_flight_dir", str, "")
+# Per-op deadline for host-tier collectives (util.collective): a recv that
+# waits longer than this aborts the op with CollectiveTimeoutError naming
+# the op, group, and the peer it was waiting on. <=0 falls back to the
+# module default (120s) — a wedged ring never hangs forever either way.
+_flag("collective_timeout_s", float, 0.0)
 
 
 class _Config:

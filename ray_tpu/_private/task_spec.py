@@ -89,6 +89,10 @@ class TaskSpec:
     #: Actor concurrency groups: {group_name: max_concurrency} (reference
     #: concurrency_group_manager.h); methods opt in via @ray_tpu.method.
     concurrency_groups: Optional[dict] = None
+    #: Per-attempt execution deadline (@remote(timeout_s=...)), enforced
+    #: worker-side: an attempt running longer is interrupted and fails as a
+    #: retryable TaskTimeoutError (system failure under max_retries).
+    timeout_s: Optional[float] = None
 
     def __getstate__(self):
         return (self.task_id, self.kind, self.name, self.function_id,
@@ -98,12 +102,14 @@ class TaskSpec:
                 self.owner_addr, self.actor_id, self.max_restarts,
                 self.max_task_retries, self.max_concurrency, self.actor_name,
                 self.namespace, self.get_if_exists, self.lifetime,
-                self.attempt, self.concurrency_groups)
+                self.attempt, self.concurrency_groups, self.timeout_s)
 
     def __setstate__(self, s):
         if len(s) == 23:  # pre-'lifetime' snapshots: insert None before attempt
             s = s[:22] + (None,) + s[22:]
         if len(s) == 24:  # pre-'concurrency_groups' snapshots
+            s = s + (None,)
+        if len(s) == 25:  # pre-'timeout_s' snapshots
             s = s + (None,)
         (self.task_id, self.kind, self.name, self.function_id,
          self.method_name, self.args, self.kwargs, self.num_returns,
@@ -112,7 +118,7 @@ class TaskSpec:
          self.owner_addr, self.actor_id, self.max_restarts,
          self.max_task_retries, self.max_concurrency, self.actor_name,
          self.namespace, self.get_if_exists, self.lifetime,
-         self.attempt, self.concurrency_groups) = s
+         self.attempt, self.concurrency_groups, self.timeout_s) = s
 
     def clone(self) -> "TaskSpec":
         """Shallow copy with its own SchedulingStrategy. The controller
@@ -165,6 +171,7 @@ class TaskSpec:
         sp.lifetime = None
         sp.attempt = attempt
         sp.concurrency_groups = None
+        sp.timeout_s = None
         return sp
 
     _NORMAL_CALL_STRATEGY: ClassVar["SchedulingStrategy"] = None  # set below
@@ -177,15 +184,18 @@ class TaskSpec:
         rates. Executor-side counterpart: `leased_task_spec`."""
         return (self.task_id, self.function_id, self.name, self.args,
                 self.kwargs, self.num_returns, self.max_retries,
-                self.retry_exceptions, self.runtime_env or None, self.attempt)
+                self.retry_exceptions, self.runtime_env or None, self.attempt,
+                self.timeout_s)
 
     @classmethod
     def for_normal_call(cls, call: tuple, owner_id: str, owner_addr,
                         resources: dict) -> "TaskSpec":
         """Rebuild an executor-side NORMAL spec from a `task_call_tuple`
         wire record (cheap constructor, same shape as for_actor_call)."""
+        if len(call) == 10:  # pre-'timeout_s' wire records
+            call = call + (None,)
         (task_id, function_id, name, args, kwargs, num_returns, max_retries,
-         retry_exceptions, runtime_env, attempt) = call
+         retry_exceptions, runtime_env, attempt, timeout_s) = call
         sp = object.__new__(cls)
         sp.task_id = task_id
         sp.kind = NORMAL
@@ -213,6 +223,7 @@ class TaskSpec:
         sp.lifetime = None
         sp.attempt = attempt
         sp.concurrency_groups = None
+        sp.timeout_s = timeout_s
         return sp
 
     def actor_call_tuple(self) -> tuple:

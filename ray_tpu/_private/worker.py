@@ -405,6 +405,9 @@ class Worker:
         self._ctrl_resolved: set[str] = set()
         self._resolutions: dict[str, _Resolution] = {}
         self._inline_cache: dict[str, list] = {}  # oid -> blob parts (small objs)
+        # oid -> (expiry, detail): GetTimeoutError enrichment cache so a
+        # tight polling loop pays the task_status probe once per window.
+        self._status_cache: dict[str, tuple] = {}
         self._lineage: dict[str, TaskSpec] = {}  # return oid -> producing spec
         # Device-ref ARG pins: first-return oid -> dref arg oids whose
         # submit-time hold is dropped when that return ref is freed (the
@@ -951,8 +954,13 @@ class Worker:
         # miss costs a stat per get() racing its producer.
         res = self._resolutions.get(oid)
         if res is not None:
-            if not res.done and not res.wait(timeout=self._remaining(deadline)):
-                raise exc.GetTimeoutError(f"get() timed out on {oid[:16]}")
+            if not res.done:
+                try:
+                    rem = self._remaining(deadline)
+                except exc.GetTimeoutError:
+                    raise self._get_timeout_error(oid) from None
+                if not res.wait(timeout=rem):
+                    raise self._get_timeout_error(oid)
             return self._materialize(oid, res.inline, res.holders, res.error, deadline)
         # 2. local caches (in-process inline / same-host shm, zero-copy)
         val, found = self._try_local(oid)
@@ -962,11 +970,93 @@ class Worker:
         rep = self.io.run(self.controller.call(
             "wait_object", oid=oid, timeout=self._remaining(deadline)))
         if rep["status"] == "timeout":
-            raise exc.GetTimeoutError(f"get() timed out on {oid[:16]}")
+            raise self._get_timeout_error(oid)
         if rep["status"] == "lost":
             raise exc.ObjectLostError(f"object {oid[:16]} lost")
         return self._materialize(oid, rep.get("inline"), [tuple(h) for h in rep.get("holders", [])],
                                  rep.get("error"), deadline)
+
+    def _get_timeout_error(self, oid: str) -> "exc.GetTimeoutError":
+        """Enriched get() timeout: name the producing task's CURRENT status
+        — queued or running, where, and how long since its last progress
+        beacon (the first question a stalled-get user asks). Direct-path
+        tasks resolve from this owner's lease tables; everything else (and
+        the beacon age) from the controller. Diagnostics only: every lookup
+        is best-effort and bounded so enrichment can never hang the error."""
+        # Polling loops (`get(ref, timeout=0.05)` in a while) expire this
+        # path at high rate: cache the enriched detail per oid for a couple
+        # of seconds so the controller round trip below is paid once per
+        # window, not once per poll.
+        now = time.monotonic()
+        cached = self._status_cache.get(oid)
+        if cached is not None and cached[0] > now:
+            return exc.GetTimeoutError(
+                f"get() timed out on {oid[:16]}{cached[1]}")
+        detail = ""
+        try:
+            tid = ObjectID.from_hex(oid).task_id().hex()
+            st = self.lease_mgr.task_status(tid) or {}
+            if not st.get("found"):
+                # Actor calls ride direct pipes: the inflight table is the
+                # only place that knows the call is still outstanding.
+                for aid, pipe in list(self._actor_pipes.items()):
+                    ent = pipe.inflight.get(tid)
+                    state = "running"
+                    if ent is None:
+                        # Not yet pushed (actor still resolving/creating):
+                        # the call is parked in the pipe's queue.
+                        ent = next((e for e in list(pipe.queue)
+                                    if e[0].task_id == tid), None)
+                        state = "queued (actor not ready)"
+                    if ent is not None:
+                        info = self._actor_info.get(aid) or {}
+                        st = {"found": True, "state": state,
+                              "via": "actor", "name": ent[0].name,
+                              "attempt": ent[0].attempt,
+                              "node_id": None,
+                              "worker_id": info.get("worker_id"),
+                              "beacon_age_s": None}
+                        break
+            ctrl = {}
+            try:
+                ctrl = self.io.run(self.controller.call(
+                    "task_status", task_id=tid, _timeout=1), timeout=2)
+            except Exception:
+                pass
+            if not st.get("found") and ctrl.get("found"):
+                st = ctrl
+            elif st.get("found") and st.get("beacon_age_s") is None:
+                st["beacon_age_s"] = ctrl.get("beacon_age_s")
+            if st.get("found"):
+                name = st.get("name") or tid[:12]
+                where = ""
+                if st.get("node_id"):
+                    where = f" on node {str(st['node_id'])[:8]}"
+                    if st.get("worker_id"):
+                        where += f" (worker {str(st['worker_id'])[:8]})"
+                via = {"direct": " via direct dispatch",
+                       "actor": " as an actor call"}.get(st.get("via"), "")
+                beacon = st.get("beacon_age_s")
+                if beacon is not None:
+                    prog = f"; {beacon:.1f}s since its last progress beacon"
+                elif st.get("state") in ("running", "queued"):
+                    prog = ("; no progress beacon (stall watchdog idle — "
+                            "set RT_STALL_WARN_S to enable)")
+                else:
+                    prog = ""
+                detail = (f": producing task {name!r} (attempt "
+                          f"{st.get('attempt')}) is {st.get('state')}"
+                          f"{where}{via}{prog}")
+            else:
+                detail = (f": producing task {tid[:12]} is unknown to the "
+                          f"cluster (finished, never submitted, or a put())")
+        except Exception:
+            detail = ""
+        if len(self._status_cache) > 64:
+            self._status_cache = {k: v for k, v in self._status_cache.items()
+                                  if v[0] > now}
+        self._status_cache[oid] = (now + 2.0, detail)
+        return exc.GetTimeoutError(f"get() timed out on {oid[:16]}{detail}")
 
     def _try_local(self, oid: str):
         parts = self._inline_cache.get(oid)
@@ -1242,6 +1332,8 @@ class Worker:
             return exc.ActorDiedError(blob.get("message", ""))
         if etype == "TaskCancelledError":
             return exc.TaskCancelledError(blob.get("message", "task cancelled"))
+        if etype == "TaskTimeoutError":
+            return exc.TaskTimeoutError(blob.get("message", "task exceeded its timeout_s"))
         if etype == "ObjectLostError":
             return exc.ObjectLostError(blob.get("message", "object lost"))
         return exc.RayTpuError(str(blob))
@@ -1660,7 +1752,8 @@ class Worker:
 
     def submit_task(self, fn, args, kwargs, *, name=None, num_returns=1, resources: ResourceSet,
                     strategy: SchedulingStrategy | None = None, max_retries: int | None = None,
-                    retry_exceptions=False, runtime_env=None) -> list[ObjectRef]:
+                    retry_exceptions=False, runtime_env=None,
+                    timeout_s: float | None = None) -> list[ObjectRef]:
         streaming = num_returns == STREAMING
         if streaming and any(k.startswith("TPU") for k in resources.raw()):
             raise ValueError(
@@ -1691,6 +1784,7 @@ class Worker:
             runtime_env=runtime_env or {},
             owner_id=self.worker_id,
             owner_addr=self.server_addr,
+            timeout_s=timeout_s,
         )
         refs = []
         for oid in spec.return_object_ids():

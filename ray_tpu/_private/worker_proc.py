@@ -23,7 +23,7 @@ import threading
 import time
 import traceback
 
-from ray_tpu._private import device_store, rpc
+from ray_tpu._private import device_store, rpc, watchdog
 from ray_tpu._private import runtime_env as _rtenv
 from ray_tpu._private.rtconfig import CONFIG
 from ray_tpu._private.serialization import dumps_oob, serialize
@@ -137,6 +137,14 @@ class WorkerProc:
         self._pins_lock = threading.Lock()  # orders flag updates vs pushes
         self._pid = os.getpid()  # cached: one event record per task must
         # not pay a getpid syscall (worker procs never fork-and-continue)
+        # Stall watchdog (README "Stall detection & watchdogs"): started in
+        # start() iff any RT_STALL_* stage is enabled. _timed_out marks
+        # (task_id, attempt) pairs whose per-attempt timeout_s deadline
+        # fired, so the resulting KeyboardInterrupt surfaces as a RETRYABLE
+        # TaskTimeoutError instead of a cancellation.
+        self._watchdog: watchdog.Watchdog | None = None
+        self._timed_out: set[tuple] = set()
+        self._current_attempt: int = 0
         self._running = True
 
     # ------------------------------------------------------------ startup
@@ -217,6 +225,96 @@ class WorkerProc:
             )
 
         self.worker.io.run(_join_agent(), timeout=CONFIG.connect_timeout_s)
+        # Stall watchdog: monitors every executing task's progress beacon
+        # and walks the warn -> dump -> kill ladder through the node agent.
+        # With all RT_STALL_* stages unset, start() is a no-op (no thread,
+        # no beacons) — escalation-off behavior is byte-identical.
+        self._watchdog = watchdog.Watchdog(
+            worker_id=self.worker_id, node_id=self.node_id,
+            session_id=self.session, on_report=self._push_stall_report,
+            on_beacon=self._push_beacon)
+        self._watchdog.start()
+
+    def _push_stall_report(self, report: dict) -> bool:
+        """Escalation stage crossed (runs on the watchdog thread): hand the
+        StallReport to the node agent — it owns stack capture (its per-pid
+        dump machinery), the storage-plane flight dump, and the kill.
+        Returns False when the hand-off provably failed so the watchdog
+        retries the stage next tick instead of marking it emitted."""
+        if self.agent_conn is None or self.agent_conn.closed:
+            return False
+        try:
+            self.agent_conn.push_threadsafe("stall_report", report=report)
+            return True
+        except Exception:
+            return False
+
+    def _push_beacon(self, task_id, silence: float):
+        """Per-tick progress beacon to the agent. Beacons STOPPING while a
+        task executes is itself a signal: the agent-side backstop escalates
+        a worker too wedged (GIL held in native code) to self-report."""
+        if self.agent_conn is None:
+            return
+        try:
+            self.agent_conn.push_threadsafe(
+                "watchdog_beacon", worker_id=self.worker_id,
+                task_id=task_id, silence=round(silence, 3))
+        except Exception:
+            pass
+
+    # ------------------------------------------------- per-attempt timeouts
+    def _arm_task_timeout(self, spec: TaskSpec):
+        """@remote(timeout_s=): arm the per-attempt execution deadline.
+        Enforced HERE (worker-side) so a spinning task is interrupted even
+        when its owner is gone; fires the same SIGINT path as cancel, but
+        the _timed_out marker reroutes the interrupt into a RETRYABLE
+        TaskTimeoutError (system failure under max_retries)."""
+        t = getattr(spec, "timeout_s", None)
+        if not t or t <= 0:
+            return None
+        ident = threading.get_ident()
+
+        def _fire():
+            # The task may have finished while the timer was in flight: only
+            # interrupt the attempt the timer was armed for.
+            if (self._current_task_id != spec.task_id
+                    or self._current_attempt != spec.attempt):
+                return
+            self._timed_out.add((spec.task_id, spec.attempt))
+            watchdog.record("task_timeout",
+                            f"{spec.name} a{spec.attempt} > {t}s")
+            try:
+                from ray_tpu.util import metrics as _metrics
+
+                _metrics.TASK_TIMEOUTS.inc(1)
+            except Exception:
+                pass
+            if ident == threading.main_thread().ident:
+                import signal
+
+                os.kill(os.getpid(), signal.SIGINT)
+            else:
+                ctypes.pythonapi.PyThreadState_SetAsyncExc(
+                    ctypes.c_ulong(ident), ctypes.py_object(KeyboardInterrupt))
+
+        timer = threading.Timer(t, _fire)
+        timer.daemon = True
+        timer.start()
+        return timer
+
+    def _consume_timeout(self, spec: TaskSpec, e: BaseException):
+        """Returns (error_blob, retryable) when the interrupt was this
+        attempt's deadline firing, else None."""
+        if not isinstance(e, KeyboardInterrupt):
+            return None
+        if (spec.task_id, spec.attempt) not in self._timed_out:
+            return None
+        self._timed_out.discard((spec.task_id, spec.attempt))
+        h, bufs = dumps_oob({
+            "type": "TaskTimeoutError",
+            "message": f"task {spec.name} (attempt {spec.attempt}) exceeded "
+                       f"its per-attempt timeout of {spec.timeout_s}s"})
+        return [h, *bufs], True
 
     async def _on_agent_push(self, conn, method, a):
         if method == "execute":
@@ -673,6 +771,7 @@ class WorkerProc:
         """Package ONE yielded stream item, advertising shm items to the
         controller immediately so third-party borrowers can fetch."""
         oid = spec.task_id + idx.to_bytes(4, "little").hex()
+        watchdog.report_progress()  # each yielded item IS progress
         result = self._serialize_return(oid, value)
         if result[3] is not None:
             # result[1] is None for host shm items and the placeholder for
@@ -881,6 +980,9 @@ class WorkerProc:
             os.environ[k] = str(v)
         undo_env = lambda: None  # noqa: E731
         self._current_task_id = spec.task_id
+        self._current_attempt = spec.attempt
+        watchdog.task_begin(spec.task_id, spec.name, spec.attempt, spec.kind)
+        timer = self._arm_task_timeout(spec)
         t0 = time.time()
         try:
             # Inside the try: a bad package (missing KV blob, corrupt zip)
@@ -907,12 +1009,20 @@ class WorkerProc:
                 args, kwargs = self.worker.decode_args(spec.args, spec.kwargs)
                 value = fn(*args, **kwargs)
         except BaseException as e:  # noqa: BLE001 — user code may raise anything
-            error_blob = self._make_error_blob(spec, e)
-            retryable = self._exception_retryable(spec, e)
+            timed_out = self._consume_timeout(spec, e)
+            if timed_out is not None:
+                error_blob, retryable = timed_out
+            else:
+                error_blob = self._make_error_blob(spec, e)
+                retryable = self._exception_retryable(spec, e)
             if spec.kind == ACTOR_CREATE:
                 logger.error("actor __init__ failed:\n%s", traceback.format_exc())
         finally:
+            if timer is not None:
+                timer.cancel()
+            self._timed_out.discard((spec.task_id, spec.attempt))
             self._current_task_id = None
+            watchdog.task_end(error_blob is None)
             self._record_event(spec, t0, time.time(), error_blob is None)
             if spec.kind != ACTOR_CREATE:  # dedicated actor procs keep their env
                 undo_env()
@@ -965,6 +1075,34 @@ class WorkerProc:
             self._current_ltask = (spec.task_id, spec.attempt, conn)
         try:
             self._execute_leased_task_inner(spec, conn)
+        except KeyboardInterrupt:
+            # A cancel/timeout SIGINT can land in any crack the inner
+            # body's own retry loops don't cover (e.g. the env-restore
+            # finally, right as the task completed): the reply may never
+            # have been delivered, and a lost reply hangs the owner's
+            # get() forever. Send a best-effort outcome — if the real
+            # reply already went out, the owner ignores this duplicate
+            # (its inflight entry is gone).
+            timed_out = (spec.task_id, spec.attempt) in self._timed_out
+            self._timed_out.discard((spec.task_id, spec.attempt))
+            if timed_out:
+                h, bufs = dumps_oob({
+                    "type": "TaskTimeoutError",
+                    "message": f"task {spec.name} (attempt {spec.attempt}) "
+                               f"exceeded its per-attempt timeout of "
+                               f"{spec.timeout_s}s"})
+                retryable = True
+            else:
+                h, bufs = dumps_oob({
+                    "type": "TaskCancelledError",
+                    "message": f"task {spec.name} cancelled"})
+                retryable = False
+            pusher = self._pusher_for(conn)
+            if pusher is not None:
+                pusher.add((spec.task_id, spec.attempt,
+                            [(oid, None, 0, None)
+                             for oid in spec.return_object_ids()],
+                            [h, *bufs], retryable, None))
         finally:
             with self._ltask_lock:
                 self._current_ltask = None
@@ -998,6 +1136,9 @@ class WorkerProc:
             os.environ[k] = str(v)
         undo_env = lambda: None  # noqa: E731
         self._current_task_id = spec.task_id
+        self._current_attempt = spec.attempt
+        watchdog.task_begin(spec.task_id, spec.name, spec.attempt, spec.kind)
+        timer = self._arm_task_timeout(spec)
         t0 = time.time()
         try:
             undo_env = _rtenv.apply(self.worker, spec.runtime_env)
@@ -1016,10 +1157,18 @@ class WorkerProc:
                     error_blob = gerr
                     retryable = self._exception_retryable(spec, gexc)
         except BaseException as e:  # noqa: BLE001 — user code may raise anything
-            error_blob = self._make_error_blob(spec, e)
-            retryable = self._exception_retryable(spec, e)
+            timed_out = self._consume_timeout(spec, e)
+            if timed_out is not None:
+                error_blob, retryable = timed_out
+            else:
+                error_blob = self._make_error_blob(spec, e)
+                retryable = self._exception_retryable(spec, e)
         finally:
+            if timer is not None:
+                timer.cancel()
+            self._timed_out.discard((spec.task_id, spec.attempt))
             self._current_task_id = None
+            watchdog.task_end(error_blob is None)
             self._record_event(spec, t0, time.time(), error_blob is None)
             undo_env()
             for k, old in saved_env.items():
@@ -1099,6 +1248,10 @@ class WorkerProc:
         value = None
         streaming = spec.num_returns == STREAMING
         gen_count = 0
+        # Progress beacon for sync actor methods (threaded/default paths;
+        # async methods ride the actor loop and are not thread-attributable).
+        watchdog.task_begin(spec.task_id, spec.name, spec.attempt,
+                            spec.kind)
         t0 = time.time()
         try:
             if self.actor_instance is None:
@@ -1117,6 +1270,7 @@ class WorkerProc:
                     error_blob = gerr
         except BaseException as e:  # noqa: BLE001
             error_blob = self._make_error_blob(spec, e)
+        watchdog.task_end(error_blob is None)
         self._record_event(spec, t0, time.time(), error_blob is None)
         if streaming:
             return {"results": self._package_stream_completion(

@@ -71,7 +71,23 @@ class TaskCancelledError(RayTpuError):
 
 
 class GetTimeoutError(RayTpuError, TimeoutError):
-    """`get()` timed out."""
+    """`get()` timed out. The message carries the producing task's status
+    (queued/running, node, seconds since its last progress beacon) when the
+    runtime can attribute it — the first question a stalled-get user asks."""
+
+
+class TaskTimeoutError(RayTpuError, TimeoutError):
+    """A task exceeded its per-attempt execution deadline
+    (`@remote(timeout_s=...)`). Enforced worker-side; treated as a system
+    failure, so the attempt retries under `max_retries` before this
+    surfaces at `get()`."""
+
+
+class CollectiveTimeoutError(RayTpuError, TimeoutError):
+    """A host-tier collective op (util.collective) exceeded its per-op
+    deadline (RT_COLLECTIVE_TIMEOUT_S) — typically a ring wedged on a sick
+    peer. The message names the op, group, rank, and the peer the op was
+    waiting on."""
 
 
 class RuntimeEnvSetupError(RayTpuError):

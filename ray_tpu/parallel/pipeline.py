@@ -112,10 +112,17 @@ def _pipeline_shard_fn(blocks, x_mb, cfg: PipelineConfig, n_stages: int):
         return nxt, y
 
     zero = jnp.zeros(mb_shape, x_mb.dtype)
-    try:
-        zero = jax.lax.pcast(zero, to="varying")
-    except (AttributeError, TypeError):
-        zero = jax.lax.pvary(zero, "pp")
+    for _mark in (lambda x: jax.lax.pcast(x, to="varying"),
+                  lambda x: jax.lax.pvary(x, "pp"),
+                  lambda x: x):
+        # Marking API differs across jax versions (pcast / pvary); builds
+        # with NEITHER (<=0.4.x) don't type-check carry variance under
+        # shard_map (check_rep=False above), so identity is correct there.
+        try:
+            zero = _mark(zero)
+            break
+        except (AttributeError, TypeError):
+            continue
     _, ys = jax.lax.scan(tick, zero, jnp.arange(T))
     # On the last stage, ys[t] for t in [S-1, S-1+M) are microbatches 0..M-1.
     outs = jax.lax.dynamic_slice_in_dim(ys, n_stages - 1, M, axis=0)

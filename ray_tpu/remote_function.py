@@ -34,6 +34,10 @@ _TASK_OPTION_KEYS = {
     "num_cpus", "num_tpus", "num_gpus", "resources", "memory", "num_returns",
     "max_retries", "retry_exceptions", "scheduling_strategy", "name",
     "runtime_env", "placement_group", "placement_group_bundle_index",
+    # Per-attempt execution deadline, enforced worker-side: an attempt
+    # running past it is interrupted and retried under max_retries as a
+    # system failure (TaskTimeoutError) — README "Stall detection".
+    "timeout_s",
 }
 
 
@@ -98,6 +102,7 @@ class RemoteFunction:
             max_retries=o.get("max_retries"),
             retry_exceptions=o.get("retry_exceptions", False),
             runtime_env=o.get("runtime_env"),
+            timeout_s=o.get("timeout_s"),
         )
         if num_returns == 1:
             return refs[0]

@@ -343,6 +343,38 @@ def cmd_checkpoints(args) -> int:
     return 0
 
 
+def cmd_stalls(args) -> int:
+    """`ray-tpu stalls` — stall-detection observability (README "Stall
+    detection & watchdogs"). Lists the StallReports the controller has
+    aggregated: every warn/dump/kill escalation from worker watchdogs,
+    every agent backstop (progress beacons stopped), and every train
+    group-stall kill. Use --verbose for the flight-recorder tail and the
+    storage path of the persisted flight dump."""
+    rows = _rpc_call(_resolve_address(args), "list_stalls",
+                     limit=args.limit)["stalls"]
+    if not rows:
+        print("no stalls recorded (escalation ladder idle — arm it with "
+              "RT_STALL_WARN_S / RT_STALL_DUMP_S / RT_STALL_KILL_S)")
+        return 0
+    print(f"{'STAGE':<6} {'SCOPE':<12} {'TASK':<24} {'SILENT':>8}  "
+          f"{'NODE':<10} {'PID':>7}  REASON")
+    for r in rows:
+        name = (r.get("name") or r.get("task_id") or "-")
+        print(f"{(r.get('stage') or '-'):<6} "
+              f"{(r.get('scope') or '-'):<12} "
+              f"{str(name)[:24]:<24} "
+              f"{(r.get('silence_s') if r.get('silence_s') is not None else '-'):>8}  "
+              f"{str(r.get('node_id') or '-')[:10]:<10} "
+              f"{(r.get('pid') or '-'):>7}  "
+              f"{(r.get('reason') or '')[:60]}")
+        if args.verbose:
+            if r.get("flight_path"):
+                print(f"       flight dump: {r['flight_path']}")
+            for ev in r.get("events") or []:
+                print(f"       {ev}")
+    return 0
+
+
 def cmd_dashboard(args) -> int:
     from ray_tpu.dashboard import Dashboard
 
@@ -401,6 +433,22 @@ def main(argv=None) -> int:
                     help="storage URI to scan directly (local://, sim://, "
                          "a bare path)")
     pc.set_defaults(fn=cmd_checkpoints)
+
+    pl = sub.add_parser(
+        "stalls",
+        help="list stall escalations (warn/dump/kill StallReports)",
+        description="List the StallReports the controller has aggregated: "
+                    "worker-watchdog escalations (a task past RT_STALL_WARN_S"
+                    "/RT_STALL_DUMP_S/RT_STALL_KILL_S of progress silence), "
+                    "node-agent backstops (progress beacons stopped), and "
+                    "train group-stall kills. dump/kill rows carry live "
+                    "thread stacks and the storage URI of the persisted "
+                    "flight dump.")
+    pl.add_argument("--address", default=None)
+    pl.add_argument("--limit", type=int, default=1000)
+    pl.add_argument("--verbose", action="store_true",
+                    help="show flight-recorder tails and dump paths")
+    pl.set_defaults(fn=cmd_stalls)
 
     pd = sub.add_parser("dashboard", help="serve the HTTP dashboard")
     pd.add_argument("--address", default=None)

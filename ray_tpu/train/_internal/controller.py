@@ -175,14 +175,19 @@ class TrainController:
             logger.warning("train group failure %d (%s); restarting from %s",
                            self.failures, outcome["error"], self.latest_checkpoint)
 
-    def _drain(self, group: WorkerGroup):
+    def _drain(self, group: WorkerGroup) -> int:
+        """Drain worker reports into history; returns how many landed —
+        the group-stall policy's definition of committed progress."""
+        n = 0
         for p in group.poll():
             for rep in p["reports"]:
+                n += 1
                 self.metrics_history.append(rep["metrics"])
                 if rep.get("checkpoint_path"):
                     self.latest_checkpoint = Checkpoint(rep["checkpoint_path"])
                     self._checkpoint_paths.append(rep["checkpoint_path"])
                     self._prune_checkpoints()
+        return n
 
     def _prune_checkpoints(self):
         keep = self.run_config.checkpoint_config.num_to_keep
@@ -204,12 +209,54 @@ class TrainController:
                 logger.exception("checkpoint prune failed for %s", victim)
             self._checkpoint_paths.pop(0)
 
+    def _report_group_stall(self, silent_s: float, stall_timeout: float):
+        """Surface the group stall through the cluster's stall plane
+        (util.state.list_stalls / rt_stalls_total) before the kill."""
+        try:
+            from ray_tpu._private.worker import global_worker
+
+            w = global_worker()
+            if w is None:
+                return
+            w.controller.push_threadsafe("stall_report", report={
+                "scope": "train_group", "stage": "kill",
+                "task_id": None, "name": self.run_name, "attempt": None,
+                "kind": "train", "worker_id": None, "node_id": None,
+                "pid": os.getpid(), "silence_s": round(silent_s, 3),
+                "time": time.time(),
+                "reason": (f"train group {self.run_name!r} committed no "
+                           f"progress for {silent_s:.1f}s (stall_timeout_s="
+                           f"{stall_timeout}); killing the group and "
+                           f"restarting from the latest committed "
+                           f"checkpoint"),
+                "events": [], "flight_dir": None,
+            })
+        except Exception:
+            pass
+
     def _run_attempt(self, group: WorkerGroup) -> dict:
+        stall_timeout = self.run_config.failure_config.stall_timeout_s
         run_refs = group.run_async(self.train_fn, self.config)
         pending = list(run_refs)
+        last_progress = time.monotonic()
         while pending:
             done, pending = ray_tpu.wait(pending, num_returns=len(pending), timeout=0.2)
-            self._drain(group)
+            if self._drain(group):
+                last_progress = time.monotonic()
+            if stall_timeout and not done:
+                silent = time.monotonic() - last_progress
+                if silent > stall_timeout:
+                    # Silent hang: workers alive, sockets open, nothing
+                    # reporting. Treat as a group failure — the caller
+                    # tears the group down and the failure policy restarts
+                    # from the latest COMMITTED checkpoint (PR 8 releases
+                    # report entries only on commit, so the restore point
+                    # is always durable).
+                    self._report_group_stall(silent, stall_timeout)
+                    return {"status": "system_failure",
+                            "error": f"train group stalled: no worker "
+                                     f"reported progress for {silent:.1f}s "
+                                     f"(stall_timeout_s={stall_timeout})"}
             for ref in done:
                 try:
                     out = ray_tpu.get(ref, timeout=30)
@@ -219,5 +266,6 @@ class TrainController:
                 if not out["ok"]:
                     self._drain(group)
                     return {"status": "user_error", "error": out["error"]}
+                last_progress = time.monotonic()
         self._drain(group)
         return {"status": "finished"}

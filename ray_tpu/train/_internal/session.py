@@ -24,6 +24,7 @@ import threading
 from typing import Any, Optional
 
 from ray_tpu import storage
+from ray_tpu._private import watchdog
 from ray_tpu.train import checkpoint as ckpt_mod
 from ray_tpu.train.checkpoint import Checkpoint
 
@@ -95,6 +96,10 @@ class TrainSession:
         persisted rank-aware through the storage backend. `checkpoint` is a
         directory Checkpoint (rank 0 owns the canonical copy) or a state
         pytree (sharded save: every rank writes its local shards)."""
+        # Every report IS progress: tick this worker's stall beacon so a
+        # healthy-but-slow step never trips the per-task watchdog while a
+        # loop that stops calling report() eventually does.
+        watchdog.report_progress()
         entry: dict[str, Any] = {"metrics": dict(metrics), "rank": self.rank}
         if checkpoint is None:
             # Queue behind any in-flight saves (handle=None releases as

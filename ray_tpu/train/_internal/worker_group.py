@@ -11,6 +11,7 @@ import traceback
 from typing import Optional
 
 import ray_tpu
+from ray_tpu import storage
 from ray_tpu.train._internal import session as session_mod
 
 
@@ -95,10 +96,22 @@ class WorkerGroup:
             opts["num_tpus"] = resources_per_worker["TPU"]
         if res:
             opts["resources"] = res
-        if worker_env:
+        env_vars = dict(worker_env or {})
+        # Stall-watchdog escalation dumps from these workers land under the
+        # RUN's storage (<run>/flight/), not the node's session dir — they
+        # must survive the worker AND travel with the run's artifacts. Only
+        # injected while the escalation ladder is actually armed (the
+        # resolved config propagates cluster-wide), so a default run's
+        # worker env stays untouched.
+        from ray_tpu._private import watchdog
+
+        if watchdog.enabled():
+            env_vars.setdefault("RT_STALL_FLIGHT_DIR",
+                                storage.join(storage_dir, "flight"))
+        if env_vars:
             # Applied at worker-process spawn, BEFORE any import runs there
             # (XLA_FLAGS etc. must precede the first jax import).
-            opts["runtime_env"] = {"env_vars": dict(worker_env)}
+            opts["runtime_env"] = {"env_vars": env_vars}
         try:
             for rank in range(num_workers):
                 self.workers.append(TrainWorkerActor.options(**opts).remote())

@@ -56,6 +56,14 @@ class FailureConfig:
     failure the whole group restarts from the latest checkpoint."""
 
     max_failures: int = 0  # 0 = fail fast; -1 = unlimited restarts
+    #: Group-stall policy (README "Stall detection & watchdogs"): a group
+    #: that commits NO progress (no report() drained from any worker) for
+    #: this long is treated as a group FAILURE — killed and restarted from
+    #: the latest committed checkpoint through the same elastic path as a
+    #: crash. Closes the silent-hang gap (a rank wedged in a collective
+    #: stops the whole group from reporting, but nothing crashes). None =
+    #: disabled.
+    stall_timeout_s: Optional[float] = None
 
 
 @dataclass

@@ -5,6 +5,7 @@ multiprocessing.Pool, collective groups, placement groups, scheduling
 strategies, the state API, and chaos tooling.
 """
 
+from ray_tpu._private.watchdog import report_progress
 from ray_tpu.util.actor_pool import ActorPool
 from ray_tpu.util.placement_group import placement_group
 from ray_tpu.util.queue import Empty, Full, Queue
@@ -15,4 +16,5 @@ __all__ = [
     "Full",
     "Queue",
     "placement_group",
+    "report_progress",
 ]

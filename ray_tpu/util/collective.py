@@ -37,10 +37,20 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
-from ray_tpu._private import rpc
+from ray_tpu import exceptions as exc
+from ray_tpu._private import rpc, watchdog
+from ray_tpu._private.rtconfig import CONFIG
 from ray_tpu._private.worker import global_worker
 
 _DEFAULT_TIMEOUT = 120.0
+
+
+def _op_timeout() -> float:
+    """Per-op deadline (RT_COLLECTIVE_TIMEOUT_S; <=0 = module default).
+    Every blocking recv inside an op is bounded by it, so a ring wedged on
+    a sick peer aborts with CollectiveTimeoutError instead of hanging."""
+    t = CONFIG.collective_timeout_s
+    return float(t) if t and t > 0 else _DEFAULT_TIMEOUT
 
 
 class ReduceOp:
@@ -87,7 +97,18 @@ def _inbox_recv(group: str, tag: str, src: int,
             if rem <= 0:
                 raise TimeoutError(
                     f"collective recv timeout: group={group} tag={tag} src={src}")
-            _inbox_cv.wait(rem)
+            # Stall plane armed: wait in slices, ticking the beacon each
+            # one — this block is BOUNDED by the op's own deadline, whose
+            # expiry produces the far more actionable
+            # CollectiveTimeoutError (it names the wedged peer), so the
+            # generic per-task kill ladder must not win the race just
+            # because RT_STALL_KILL_S < the op deadline. Unarmed (the
+            # default): one full-duration wait, zero extra wakeups.
+            if watchdog.is_armed():
+                _inbox_cv.wait(min(rem, 0.25))
+                watchdog.report_progress()
+            else:
+                _inbox_cv.wait(rem)
 
 
 @dataclass
@@ -195,6 +216,26 @@ def _send_to(g: _Group, rank: int, tag: str, blob: bytes):
         "col_msg", group=g.name, tag=tag, src=g.rank, blob=blob)
 
 
+def _recv_step(g: _Group, op: str, tag: str, src: int) -> bytes:
+    """One bounded ring/p2p receive. A deadline expiry names the op, the
+    group, this rank, and the peer the recv was WAITING on — on a ring
+    that peer (or someone upstream of it) is the wedged one. Each
+    completed step ticks the stall watchdog's progress beacon: a long
+    healthy collective is progress, not a stall."""
+    try:
+        blob = _inbox_recv(g.name, tag, src, timeout=_op_timeout())
+    except TimeoutError:
+        watchdog.record("collective_timeout", f"{op} {g.name} <- r{src}")
+        raise exc.CollectiveTimeoutError(
+            f"collective {op!r} timed out after {_op_timeout():.1f}s in "
+            f"group {g.name!r} (rank {g.rank}/{g.world_size}, seq {g.seq}): "
+            f"still waiting on peer rank {src} — it (or a rank upstream of "
+            f"it on the ring) has stalled or died; set "
+            f"RT_COLLECTIVE_TIMEOUT_S to tune this deadline") from None
+    watchdog.report_progress()
+    return blob
+
+
 # ------------------------------------------------------------- collectives
 def allreduce(tensor, op: str = ReduceOp.SUM, group_name: str = "default"):
     """Allreduce a numpy array (or pytree of arrays) across the group via
@@ -227,7 +268,7 @@ def allgather(tensor, group_name: str = "default") -> list:
     carry = pickle.dumps(tensor, protocol=5)
     for step in range(W - 1):
         _send_to(g, nxt, f"ag{seq}.{step}", carry)
-        carry = _inbox_recv(g.name, f"ag{seq}.{step}", prv)
+        carry = _recv_step(g, "allgather", f"ag{seq}.{step}", prv)
         out[(r - 1 - step) % W] = pickle.loads(carry)
     return out
 
@@ -245,7 +286,7 @@ def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
     if r == src_rank:
         _send_to(g, nxt, tag, pickle.dumps(tensor, protocol=5))
         return tensor
-    blob = _inbox_recv(g.name, tag, prv)
+    blob = _recv_step(g, "broadcast", tag, prv)
     if nxt != src_rank:
         _send_to(g, nxt, tag, blob)
     return pickle.loads(blob)
@@ -274,9 +315,9 @@ def barrier(group_name: str = "default"):
         tag = f"bar{seq}.{lap}"
         if r == 0:
             _send_to(g, nxt, tag, b"")
-            _inbox_recv(g.name, tag, prv)
+            _recv_step(g, "barrier", tag, prv)
         else:
-            _inbox_recv(g.name, tag, prv)
+            _recv_step(g, "barrier", tag, prv)
             _send_to(g, nxt, tag, b"")
 
 
@@ -292,7 +333,7 @@ def send(tensor, dst_rank: int, group_name: str = "default"):
 def recv(src_rank: int, group_name: str = "default"):
     g = _manager.get(group_name)
     n = g.p2p_rcvd[src_rank] = g.p2p_rcvd.get(src_rank, 0) + 1
-    return pickle.loads(_inbox_recv(g.name, f"p2p{n}", src_rank))
+    return pickle.loads(_recv_step(g, "recv", f"p2p{n}", src_rank))
 
 
 # ---------------------------------------------------------- ring allreduce
@@ -329,7 +370,7 @@ def _ring_allreduce(g: _Group, seq: int, arrs: list, reduce2) -> list:
         sb, rb = (r - t) % W, (r - t - 1) % W
         _send_to(g, nxt, f"rs{seq}.{t}",
                  pickle.dumps(acc[sb], protocol=5))
-        inc = pickle.loads(_inbox_recv(g.name, f"rs{seq}.{t}", prv))
+        inc = pickle.loads(_recv_step(g, "allreduce", f"rs{seq}.{t}", prv))
         acc[rb] = [reduce2(a, b) for a, b in zip(acc[rb], inc)]
     carry = pickle.dumps(acc[(r + 1) % W], protocol=5)
     for t in range(W - 1):
@@ -338,7 +379,7 @@ def _ring_allreduce(g: _Group, seq: int, arrs: list, reduce2) -> list:
         # serialized bucket at every hop would cost ~2.G.(W-2)/W extra
         # serialization work per allreduce.
         _send_to(g, nxt, f"ag{seq}.{t}", carry)
-        carry = _inbox_recv(g.name, f"ag{seq}.{t}", prv)
+        carry = _recv_step(g, "allreduce", f"ag{seq}.{t}", prv)
         acc[rb] = pickle.loads(carry)
     out = [None] * len(arrs)
     for b, idxs in enumerate(buckets):

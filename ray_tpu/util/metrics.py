@@ -243,3 +243,16 @@ CHECKPOINT_BYTES = Counter(
 CHECKPOINT_COMMITTED = Counter(
     "rt_checkpoint_committed_total",
     description="checkpoints committed (manifest rename succeeded)")
+
+#: Per-attempt execution deadlines that fired (@remote(timeout_s=...)),
+#: minted worker-side as the deadline interrupts the attempt. A non-zero
+#: rate under a healthy workload means timeout_s is set too tight — or
+#: something really is wedging tasks (cross-check rt_stalls_total).
+TASK_TIMEOUTS = Counter(
+    "rt_task_timeouts_total",
+    description="task attempts killed by their per-attempt timeout_s")
+
+#: Stall escalations are aggregated controller-side from StallReports
+#: (`rt_stalls_total{stage=warn|dump|kill}` — see controller._p_stall_report);
+#: no worker-side series exists because a stalled worker may be too wedged
+#: to flush metrics at all.

@@ -74,6 +74,16 @@ def list_checkpoints(path: str | None = None, limit: int = 1000) -> list[dict]:
     return rows
 
 
+def list_stalls(limit: int = 1000) -> list[dict]:
+    """StallReports the controller has aggregated (README "Stall detection
+    & watchdogs"): one row per escalation stage crossed anywhere in the
+    cluster — worker watchdogs (stage warn/dump/kill), agent backstops
+    (beacons stopped), and train group-stall kills. Rows carry the task,
+    where it ran, how long it was silent, the flight-recorder tail, and
+    (dump/kill) the storage path of the persisted flight dump."""
+    return _call("list_stalls", limit=limit)["stalls"]
+
+
 def metrics() -> list[dict]:
     """Aggregated application metrics (ray_tpu.util.metrics Counter/Gauge/
     Histogram series, reference `ray metrics` / Prometheus export)."""

@@ -407,6 +407,81 @@ def test_coalesced_burst_dup_and_delay_per_frame(xport_injector):
         h.close()
 
 
+def test_hang_holds_stream_without_closing(xport_injector):
+    """'hang' vs 'drop' distinction: drop removes ONE frame and later
+    frames still flow; hang holds the matched frame AND everything behind
+    it forever while the socket stays healthy (neither side observes a
+    close) — the silent-stall chaos primitive."""
+    h = _XportHarness()
+    try:
+        rule = xport_injector.add_rule(
+            "xport", "hang", direction="send", methods={"p"}, after=3)
+        assert h.burst(8) == []  # pushes buffer fine; nothing errors
+        time.sleep(0.5)
+        # Only the pre-hang prefix arrives; the held frame and everything
+        # younger never do.
+        assert h.got == [0, 1, 2], h.got
+        assert rule.applied >= 1
+        # The connection is NOT closed — that's what distinguishes a hang
+        # from a sever: liveness machinery keyed on connection close (PR 2)
+        # never fires.
+        assert not h.conn.closed
+        time.sleep(0.3)
+        assert h.got == [0, 1, 2]
+    finally:
+        h.close()
+
+
+def test_drop_vs_hang_on_local_transport(xport_injector):
+    """Same distinction on the in-process LocalConnection transport: a
+    dropped request errors its reply future; a hung one never resolves
+    (and later frames wedge behind it) with the link still 'healthy'."""
+    import asyncio
+
+    io = rpc.EventLoopThread(name="local-srv")
+
+    async def on_req(conn, method, a):
+        return a["i"]
+
+    server = rpc.RpcServer(on_req, None)
+    port = io.run(server.start("127.0.0.1", 0))
+    cio = rpc.EventLoopThread(name="local-cli")
+    try:
+        conn = cio.run(rpc.connect("127.0.0.1", port, label="loc"))
+        assert isinstance(conn, rpc.LocalConnection)
+        # drop: the reply future fails fast (frame provably gone).
+        xport_injector.add_rule("loc", "drop", direction="send",
+                                methods={"m"}, times=1)
+        try:
+            cio.run(conn.call("m", i=1), timeout=5)
+            raise AssertionError("dropped call resolved")
+        except rpc.ConnectionClosed:
+            pass
+        assert cio.run(conn.call("m", i=2), timeout=5) == 2  # later frames flow
+        # hang: the call never resolves, the link never closes, and later
+        # frames wedge behind the held one.
+        xport_injector.add_rule("loc", "hang", direction="send",
+                                methods={"m"})
+
+        async def hung_call():
+            try:
+                await asyncio.wait_for(conn.call("m", i=3), 0.8)
+                return "resolved"
+            except asyncio.TimeoutError:
+                return "hung"
+
+        assert cio.run(hung_call(), timeout=10) == "hung"
+        assert not conn.closed
+        assert cio.run(hung_call(), timeout=10) == "hung"  # wedged behind
+    finally:
+        try:
+            io.run(server.stop(), timeout=5)
+        except Exception:
+            pass
+        cio.stop()
+        io.stop()
+
+
 def test_call_start_pipelined_ordering_survives_coalescing(xport_injector):
     """call_start's contract — requests hit the peer in issue order while
     replies overlap — must hold when the frames ride one coalesced write."""

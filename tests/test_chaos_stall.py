@@ -1,0 +1,271 @@
+"""Chaos tests for the stall-detection plane (README "Stall detection &
+watchdogs"): silent hangs must become fast, attributed failures.
+
+Pinned here:
+- a stalled task walks the warn -> dump -> kill escalation ladder, its
+  flight dump survives in storage, and the RETRY completes exactly once;
+- a collective wedged on a sick peer aborts with CollectiveTimeoutError
+  naming the op, group, and peer — never hangs the suite;
+- @remote(timeout_s=) interrupts a runaway attempt worker-side and retries
+  it under max_retries as a system failure (TaskTimeoutError when spent);
+- get(timeout=) on a still-pending object names the producing task's
+  status instead of a bare timeout;
+- a train group that stops reporting restarts elastically from the latest
+  COMMITTED checkpoint;
+- with every RT_STALL_* stage unset, nothing beacons and nothing reports —
+  escalation off is byte-identical.
+"""
+
+import os
+import pickle
+import tempfile
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import exceptions as exc
+from ray_tpu.util import state
+
+
+def _attempt_marker():
+    """Cross-process attempt counter: returns (path, bump) where bump()
+    increments and returns the pre-increment count."""
+    path = tempfile.mktemp(prefix="rt_stall_marker_")
+    return path
+
+
+@ray_tpu.remote(max_retries=2)
+def stalls_on_first_attempt(path):
+    import os
+    import time as _t
+
+    n = int(open(path).read()) if os.path.exists(path) else 0
+    with open(path, "w") as f:
+        f.write(str(n + 1))
+    if n == 0:
+        _t.sleep(120)  # silent stall: alive, socket open, no progress
+    return n + 1
+
+
+def test_stalled_task_escalates_warn_dump_kill_and_retries(shutdown_only):
+    ray_tpu.init(num_cpus=2, _system_config={
+        "stall_warn_s": 0.6,
+        "stall_dump_s": 1.1,
+        "stall_kill_s": 1.8,
+        "stall_beacon_interval_s": 0.2,
+    })
+    marker = _attempt_marker()
+    t0 = time.monotonic()
+    out = ray_tpu.get(stalls_on_first_attempt.remote(marker), timeout=60)
+    elapsed = time.monotonic() - t0
+    # The retry ran EXACTLY once: first attempt stalled and was killed,
+    # second returned 2; a third run would have written 3.
+    assert out == 2
+    time.sleep(0.3)
+    assert open(marker).read() == "2"
+    # The stalled get resolved via the kill + retry, not a 120s sleep.
+    assert elapsed < 30
+    stalls = state.list_stalls()
+    by_stage = {s["stage"] for s in stalls
+                if s.get("name") == "stalls_on_first_attempt"}
+    assert {"warn", "dump", "kill"} <= by_stage, stalls
+    dump = next(s for s in stalls if s["stage"] == "dump"
+                and s.get("name") == "stalls_on_first_attempt")
+    # Dump-stage escalation captured live stacks through the agent's
+    # per-pid machinery and persisted the flight dump through storage.
+    assert dump.get("stacks"), "no stack capture on dump escalation"
+    assert "sleep" in dump["stacks"] or "stalls_on_first" in dump["stacks"]
+    assert dump.get("flight_path") and os.path.exists(dump["flight_path"])
+    # The persisted dump carries the flight-recorder ring.
+    import json
+
+    persisted = json.loads(open(dump["flight_path"]).read())
+    assert persisted["stage"] == "dump"
+    assert isinstance(persisted.get("events"), list)
+    # Escalations are counted per stage.
+    mets = {(m["name"], m["tags"].get("stage")): m["value"]
+            for m in state.metrics() if m["name"] == "rt_stalls_total"}
+    assert mets.get(("rt_stalls_total", "kill"), 0) >= 1
+    assert mets.get(("rt_stalls_total", "warn"), 0) >= 1
+
+
+def test_stalls_cli_lists_reports(shutdown_only):
+    ray_tpu.init(num_cpus=1, _system_config={
+        "stall_warn_s": 0.4, "stall_kill_s": 1.2,
+        "stall_beacon_interval_s": 0.1,
+    })
+    marker = _attempt_marker()
+    assert ray_tpu.get(stalls_on_first_attempt.remote(marker), timeout=60) == 2
+
+    from ray_tpu.scripts.cli import main as cli_main
+
+    host, port = ray_tpu._head.controller_addr
+    rc = cli_main(["stalls", "--address", f"{host}:{port}", "--verbose"])
+    assert rc == 0
+
+
+@ray_tpu.remote(timeout_s=0.6, max_retries=1)
+def slow_then_fast(path):
+    import os
+    import time as _t
+
+    n = int(open(path).read()) if os.path.exists(path) else 0
+    with open(path, "w") as f:
+        f.write(str(n + 1))
+    if n == 0:
+        _t.sleep(60)
+    return "done"
+
+
+@ray_tpu.remote(timeout_s=0.5, max_retries=0)
+def always_slow():
+    import time as _t
+
+    _t.sleep(60)
+
+
+def test_task_timeout_s_retries_then_surfaces(shutdown_only):
+    ray_tpu.init(num_cpus=2)
+    # Attempt 0 blows its per-attempt deadline -> retried as a system
+    # failure -> attempt 1 returns.
+    marker = _attempt_marker()
+    t0 = time.monotonic()
+    assert ray_tpu.get(slow_then_fast.remote(marker), timeout=30) == "done"
+    assert time.monotonic() - t0 < 20
+    assert open(marker).read() == "2"
+    # Retries spent -> TaskTimeoutError reaches the caller.
+    with pytest.raises(exc.TaskTimeoutError, match="per-attempt timeout"):
+        ray_tpu.get(always_slow.remote(), timeout=30)
+
+
+def test_get_timeout_names_producing_task(shutdown_only):
+    ray_tpu.init(num_cpus=1)
+
+    @ray_tpu.remote
+    def napper():
+        import time as _t
+
+        _t.sleep(8)
+        return 1
+
+    ref = napper.remote()
+    time.sleep(0.3)
+    with pytest.raises(exc.GetTimeoutError) as ei:
+        ray_tpu.get(ref, timeout=0.5)
+    msg = str(ei.value)
+    assert "napper" in msg, msg
+    assert "running" in msg or "queued" in msg, msg
+    assert ray_tpu.get(ref, timeout=30) == 1
+
+
+def test_collective_timeout_names_op_group_peer(shutdown_only):
+    ray_tpu.init(num_cpus=2, _system_config={"collective_timeout_s": 2.0})
+
+    @ray_tpu.remote
+    class Rank:
+        def __init__(self, rank):
+            self.rank = rank
+
+        def join(self, world):
+            from ray_tpu.util import collective
+
+            collective.init_collective_group(world, self.rank, "wedge")
+            return True
+
+        def reduce(self):
+            import numpy as np
+
+            from ray_tpu.util import collective
+
+            return collective.allreduce(np.ones(8), group_name="wedge")
+
+        def sit(self):
+            import time as _t
+
+            _t.sleep(60)
+
+    a, b = Rank.remote(0), Rank.remote(1)
+    assert ray_tpu.get([a.join.remote(2), b.join.remote(2)], timeout=60)
+    b.sit.remote()  # rank 1 wedges instead of joining the allreduce
+    t0 = time.monotonic()
+    with pytest.raises(exc.TaskError) as ei:
+        ray_tpu.get(a.reduce.remote(), timeout=30)
+    elapsed = time.monotonic() - t0
+    msg = str(ei.value)
+    # Aborted within the configured deadline (plus slack), never hanging
+    # the suite; the error names op, group, and the wedged peer.
+    assert elapsed < 15
+    assert "CollectiveTimeoutError" in msg
+    assert "allreduce" in msg and "wedge" in msg and "peer rank 1" in msg
+    assert isinstance(ei.value.cause, exc.CollectiveTimeoutError)
+
+
+def _stall_train_loop(config):
+    import ray_tpu.train as train
+
+    ckpt = train.get_checkpoint()
+    if ckpt is not None:  # restarted attempt: resume from the commit
+        with open(os.path.join(ckpt.path, "state.pkl"), "rb") as f:
+            saved = pickle.load(f)
+        train.report({"step": saved["step"] + 1, "resumed": 1})
+        return
+    with tempfile.TemporaryDirectory() as d:
+        with open(os.path.join(d, "state.pkl"), "wb") as f:
+            pickle.dump({"step": 1}, f)
+        train.report({"step": 1}, checkpoint=train.Checkpoint(d))
+    time.sleep(120)  # silent group stall: alive, no reports, no crash
+
+
+def test_train_group_stall_restarts_from_committed_checkpoint(shutdown_only):
+    from ray_tpu.train import (
+        FailureConfig,
+        JaxTrainer,
+        RunConfig,
+        ScalingConfig,
+    )
+
+    ray_tpu.init(num_cpus=2)
+    with tempfile.TemporaryDirectory() as storage_dir:
+        trainer = JaxTrainer(
+            _stall_train_loop,
+            train_loop_config={},
+            scaling_config=ScalingConfig(num_workers=1),
+            run_config=RunConfig(
+                name="stall_run",
+                storage_path=storage_dir,
+                failure_config=FailureConfig(
+                    max_failures=2, stall_timeout_s=2.5),
+            ),
+        )
+        t0 = time.monotonic()
+        result = trainer.fit()
+        elapsed = time.monotonic() - t0
+        assert result.error is None, result.error
+        # Second attempt resumed from the checkpoint the first committed.
+        assert result.metrics.get("resumed") == 1
+        assert result.metrics.get("step") == 2
+        assert elapsed < 90
+        # The group stall surfaced through the cluster stall plane.
+        rows = [s for s in state.list_stalls()
+                if s.get("scope") == "train_group"]
+        assert rows and rows[0]["stage"] == "kill"
+
+
+def test_escalation_disabled_is_inert(shutdown_only):
+    """No RT_STALL_* stage set: the watchdog never starts, nothing beacons,
+    nothing reports — a slow task is just a slow task."""
+    ray_tpu.init(num_cpus=1)
+
+    @ray_tpu.remote
+    def slowish():
+        import time as _t
+
+        _t.sleep(1.2)
+        return "ok"
+
+    assert ray_tpu.get(slowish.remote(), timeout=30) == "ok"
+    assert state.list_stalls() == []
+    assert not any(m["name"] == "rt_stalls_total" for m in state.metrics())
+    # No beacon state ever reached the controller either.
+    assert ray_tpu._head.controller._task_beacons == {}
