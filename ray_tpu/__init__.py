@@ -135,6 +135,10 @@ def shutdown():
         try:
             CONFIG._overrides.clear()
             CONFIG._overrides.update(_config_baseline)
+            # The cluster snapshot received at registration is session
+            # state too: a later init() against a different cluster must
+            # not inherit this one's resolved table.
+            CONFIG._snapshot.clear()
         except Exception:
             pass
         _config_baseline = None

@@ -371,7 +371,7 @@ class LeaseManager:
 
     def _task_done(self, lease: _Lease, item: tuple):
         # item: (task_id, attempt, results, error, retryable, exec_failure)
-        tid, _attempt, results, error, retryable, _ef = item
+        tid, _attempt, results, error, retryable, _ef = item  # rtcheck: wire=tasks_done.item
         spec = lease.inflight.pop(tid, None)
         if spec is None:
             self._cancelled.pop(tid, None)

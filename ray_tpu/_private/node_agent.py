@@ -364,9 +364,18 @@ class NodeAgent:
                 except OSError:
                     continue
                 if size > offset and size == last:
-                    with open(path) as f:
-                        f.seek(offset)
-                        return {"found": True, "pid": pid, "stacks": f.read()}
+                    # Read off the loop: the dump is usually small, but this
+                    # loop also carries heartbeats and every worker's RPC —
+                    # a slow /tmp (or a huge threaded-actor dump) must not
+                    # stall them.
+                    def _read_dump(path=path, offset=offset):
+                        with open(path) as f:
+                            f.seek(offset)
+                            return f.read()
+
+                    stacks = await asyncio.get_running_loop(
+                        ).run_in_executor(None, _read_dump)
+                    return {"found": True, "pid": pid, "stacks": stacks}
                 last = size
             return {"found": False, "stacks": "worker did not dump in time"}
 

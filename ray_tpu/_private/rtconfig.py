@@ -180,13 +180,33 @@ _flag("stall_flight_dir", str, "")
 # the op, group, and the peer it was waiting on. <=0 falls back to the
 # module default (120s) — a wedged ring never hangs forever either way.
 _flag("collective_timeout_s", float, 0.0)
+# --- kernels / diagnostics --------------------------------------------------
+# Decode-attention kernel selection: "pallas" / "xla" force a path, ""
+# keeps the size-based dispatch (ops/decode_attention.py
+# PALLAS_MIN_CACHE_BYTES).
+_flag("decode_kernel", str, "")
+# Non-empty: worker processes run under cProfile and write
+# <dir>/worker_<pid>.pstats at exit (dev profiling; costs ~2x on hot paths).
+_flag("profile_worker", str, "")
 
 
 class _Config:
-    """Attribute access to flags with env + runtime overrides."""
+    """Attribute access to flags, resolved in precedence order:
+
+    1. explicit `init(_system_config={...})` overrides (this process)
+    2. the process's own `RT_<NAME>` env var
+    3. the cluster snapshot received at registration
+    4. the registry default
+
+    Env sits ABOVE the snapshot deliberately: the snapshot carries the
+    controller-side resolved table to every node, but a per-process env
+    injection (e.g. train pointing each worker's RT_STALL_FLIGHT_DIR at
+    <run>/flight, or arming RT_PROFILE_WORKER on one worker) must win on
+    that process — it is the most specific setting there is."""
 
     def __init__(self):
         self._overrides: dict[str, Any] = {}
+        self._snapshot: dict[str, Any] = {}
 
     def apply_system_config(self, overrides: dict[str, Any] | None) -> None:
         if not overrides:
@@ -203,7 +223,7 @@ class _Config:
         return {k: getattr(self, k) for k in _REGISTRY}
 
     def load_snapshot(self, snap: dict[str, Any]) -> None:
-        self._overrides.update(snap)
+        self._snapshot.update(snap)
 
     def __getattr__(self, name: str):
         if name.startswith("_"):
@@ -220,6 +240,8 @@ class _Config:
             if typ in (dict, list):
                 return json.loads(env)
             return typ(env)
+        if name in self._snapshot:
+            return self._snapshot[name]
         return default
 
 

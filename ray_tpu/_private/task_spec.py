@@ -182,7 +182,7 @@ class TaskSpec:
         class's resources/strategy — ride once per frame; the full 24-field
         spec pickle costs ~3x this on encode+decode at direct-dispatch
         rates. Executor-side counterpart: `leased_task_spec`."""
-        return (self.task_id, self.function_id, self.name, self.args,
+        return (self.task_id, self.function_id, self.name, self.args,  # rtcheck: wire=exec_tasks.call
                 self.kwargs, self.num_returns, self.max_retries,
                 self.retry_exceptions, self.runtime_env or None, self.attempt,
                 self.timeout_s)
@@ -194,7 +194,7 @@ class TaskSpec:
         wire record (cheap constructor, same shape as for_actor_call)."""
         if len(call) == 10:  # pre-'timeout_s' wire records
             call = call + (None,)
-        (task_id, function_id, name, args, kwargs, num_returns, max_retries,
+        (task_id, function_id, name, args, kwargs, num_returns, max_retries,  # rtcheck: wire=exec_tasks.call
          retry_exceptions, runtime_env, attempt, timeout_s) = call
         sp = object.__new__(cls)
         sp.task_id = task_id
@@ -230,7 +230,7 @@ class TaskSpec:
         """Compact wire record for `actor_calls` frames — the full 24-field
         spec pickle costs ~9us/call encode+decode and 293B; this is ~1/3 of
         both. Frame-constant fields (owner, actor id) ride once per frame."""
-        return (self.task_id, self.method_name, self.args, self.kwargs,
+        return (self.task_id, self.method_name, self.args, self.kwargs,  # rtcheck: wire=actor_calls.call
                 self.num_returns, self.name, self.attempt)
 
     def ref_arg_oids(self) -> list[str]:
@@ -275,7 +275,7 @@ TaskSpec._NORMAL_CALL_STRATEGY = SchedulingStrategy()
 
 def actor_call_spec(call: tuple, owner_id: str, owner_addr, actor_id: str) -> TaskSpec:
     """Rebuild an executor-side spec from an `actor_calls` wire record."""
-    task_id, method_name, args, kwargs, num_returns, name, attempt = call
+    task_id, method_name, args, kwargs, num_returns, name, attempt = call  # rtcheck: wire=actor_calls.call
     return TaskSpec.for_actor_call(
         task_id, method_name, args, kwargs, num_returns, name,
         owner_id, tuple(owner_addr) if owner_addr else None, actor_id,

@@ -200,8 +200,9 @@ def build_report(st: dict, stage: str, *, worker_id: str, node_id: str,
         "time": time.time(),
         "reason": reason or f"no progress for {silence_s:.1f}s",
         "events": flight_events(),
-        "flight_dir": (os.environ.get("RT_STALL_FLIGHT_DIR")
-                       or CONFIG.stall_flight_dir
+        # CONFIG resolves _system_config overrides first, then the
+        # RT_STALL_FLIGHT_DIR env (train runs inject it per worker).
+        "flight_dir": (CONFIG.stall_flight_dir
                        or default_flight_dir(session_id)),
     }
 

@@ -1983,7 +1983,7 @@ class Worker:
 
     def _apply_actor_reply(self, spec: TaskSpec, rep: tuple):
         # rep: (task_id, attempt, results, error, retryable, exec_failure)
-        _tid, _attempt, results, error, _retryable, exec_failure = rep
+        _tid, _attempt, results, error, _retryable, exec_failure = rep  # rtcheck: wire=tasks_done.item
         if exec_failure and not results:
             # The actor's executor layer failed before results were packaged:
             # fail the refs rather than leaving the caller blocked forever.
@@ -2154,7 +2154,7 @@ class _ActorPipe:
         if method != "tasks_done":
             return
         for item in a["done"]:
-            ent = self.inflight.pop(item[0], None)
+            ent = self.inflight.pop(item[0], None)  # rtcheck: wire=tasks_done.item
             if ent is None:
                 continue
             self.w._apply_actor_reply(ent[0], item)

@@ -436,7 +436,7 @@ class WorkerProc:
                                      "message": f"task {spec.name} cancelled"})
                 pusher = self._pusher_for(conn)
                 if pusher is not None:
-                    pusher.add((spec.task_id, spec.attempt,
+                    pusher.add((spec.task_id, spec.attempt,  # rtcheck: wire=tasks_done.item
                                 [(oid, None, 0, None)
                                  for oid in spec.return_object_ids()],
                                 [h, *bufs], False, None))
@@ -473,7 +473,12 @@ class WorkerProc:
                     self._dispatch_actor_task(spec, None)
                 else:
                     self._execute_task(spec)
-            except BaseException:
+            except BaseException as e:
+                # A late cancel/timeout SIGINT (KeyboardInterrupt) escaping
+                # the per-task guards must not fell the exec loop — the
+                # worker keeps draining its queue; attribute what survived.
+                print(f"exec loop survived {type(e).__name__} "
+                      f"(task dispatch)", file=sys.stderr)
                 traceback.print_exc()
         self.worker.disconnect()
 
@@ -644,7 +649,7 @@ class WorkerProc:
         if pusher is not None:  # None once the holder's connection closed
             # Compact wire record (see _done_item): dict replies with five
             # constant keys cost ~2x the pickle of a tuple at n:n rates.
-            pusher.add((task_id, 0, reply.get("results"), reply.get("error"),
+            pusher.add((task_id, 0, reply.get("results"), reply.get("error"),  # rtcheck: wire=tasks_done.item
                         False, reply.get("exec_failure")))
 
     def _reply_future(self, pusher, task_id: str, done_future):
@@ -1099,7 +1104,7 @@ class WorkerProc:
                 retryable = False
             pusher = self._pusher_for(conn)
             if pusher is not None:
-                pusher.add((spec.task_id, spec.attempt,
+                pusher.add((spec.task_id, spec.attempt,  # rtcheck: wire=tasks_done.item
                             [(oid, None, 0, None)
                              for oid in spec.return_object_ids()],
                             [h, *bufs], retryable, None))
@@ -1192,7 +1197,7 @@ class WorkerProc:
         # Compact `tasks_done` item (parsed by lease._task_done /
         # _ActorPipe._on_push): (task_id, attempt, results, error,
         # retryable, exec_failure).
-        payload = (spec.task_id, spec.attempt, results, error_blob,
+        payload = (spec.task_id, spec.attempt, results, error_blob,  # rtcheck: wire=tasks_done.item
                    retryable, None)
         # Don't advertise transient (to-be-retried) errors: the owner will
         # resubmit, and a poisoned directory entry would outlive the retry.
@@ -1336,7 +1341,7 @@ def main():
             try:
                 _prof[0].disable()
                 _prof[0].dump_stats(os.path.join(
-                    os.environ["RT_PROFILE_WORKER"], f"worker_{os.getpid()}.pstats"))
+                    CONFIG.profile_worker, f"worker_{os.getpid()}.pstats"))
             except Exception:
                 pass
         rpc.cleanup_sockets()
@@ -1347,7 +1352,7 @@ def main():
     logging.basicConfig(level=logging.INFO, format=f"[worker %(process)d] %(message)s")
     proc = WorkerProc()
     proc.start()
-    profile_dir = os.environ.get("RT_PROFILE_WORKER")
+    profile_dir = CONFIG.profile_worker
     if profile_dir:  # dev-only: per-worker cProfile dumps for hot-path work
         import cProfile
 

@@ -169,9 +169,9 @@ def decode_attention(q, k_cache, v_cache, lengths, *, interpret: bool = False):
     Pallas streaming kernel (env RT_DECODE_KERNEL forces one).
     q: [B, H, D]; caches [B, S, KV, D]; lengths [B] -> [B, H, D]."""
     global _warned
-    import os
+    from ray_tpu._private.rtconfig import CONFIG
 
-    force = os.environ.get("RT_DECODE_KERNEL", "").lower()
+    force = str(CONFIG.decode_kernel).lower()
     on_tpu = jax.devices()[0].platform == "tpu"
     cache_bytes = 2 * k_cache.size * k_cache.dtype.itemsize
     want_pallas = (force == "pallas"

@@ -375,6 +375,45 @@ def cmd_stalls(args) -> int:
     return 0
 
 
+def cmd_lint(args) -> int:
+    """`ray-tpu lint` — the rtcheck static analysis suite (README "Static
+    analysis & invariants"): five AST passes encoding the runtime's
+    invariants (async-blocking, wire-schema, knob-registry,
+    lock-discipline, exception-taxonomy). Exit 0 = no non-baselined
+    findings."""
+    try:
+        from tools.rtcheck import core as rtcheck_core
+    except ImportError:
+        # Installed entry point outside the repo (or a foreign top-level
+        # `tools` package shadowing ours): resolve tools/ relative to the
+        # ray_tpu package's checkout and retry with the stale module
+        # purged — sys.modules would otherwise pin the foreign package.
+        import ray_tpu
+
+        repo = os.path.dirname(os.path.dirname(
+            os.path.abspath(ray_tpu.__file__)))
+        if not os.path.isdir(os.path.join(repo, "tools", "rtcheck")):
+            print("ray-tpu lint needs the tools/rtcheck checkout "
+                  "(run from the repo)", file=sys.stderr)
+            return 2
+        for mod in [m for m in sys.modules
+                    if m == "tools" or m.startswith("tools.")]:
+            del sys.modules[mod]
+        sys.path.insert(0, repo)
+        try:
+            from tools.rtcheck import core as rtcheck_core
+        except ImportError as e:
+            print(f"ray-tpu lint could not import tools/rtcheck from "
+                  f"{repo}: {e}", file=sys.stderr)
+            return 2
+    argv = list(args.paths)
+    if args.json:
+        argv.append("--json")
+    if args.no_cache:
+        argv.append("--no-cache")
+    return rtcheck_core.main(argv)
+
+
 def cmd_dashboard(args) -> int:
     from ray_tpu.dashboard import Dashboard
 
@@ -449,6 +488,22 @@ def main(argv=None) -> int:
     pl.add_argument("--verbose", action="store_true",
                     help="show flight-recorder tails and dump paths")
     pl.set_defaults(fn=cmd_stalls)
+
+    pn = sub.add_parser(
+        "lint",
+        help="run the rtcheck static analysis suite",
+        description="Run tools/rtcheck: the five invariant passes "
+                    "(async-blocking, wire-schema, knob-registry, "
+                    "lock-discipline, exception-taxonomy) over ray_tpu/ + "
+                    "tools/. Suppress deliberate findings inline with "
+                    "`# rtcheck: disable=<pass>`; grandfathered findings "
+                    "live in tools/rtcheck/baseline.json.")
+    pn.add_argument("paths", nargs="*", default=[],
+                    help="roots to analyze (default: ray_tpu tools)")
+    pn.add_argument("--json", action="store_true",
+                    help="machine-readable findings for tooling")
+    pn.add_argument("--no-cache", action="store_true")
+    pn.set_defaults(fn=cmd_lint)
 
     pd = sub.add_parser("dashboard", help="serve the HTTP dashboard")
     pd.add_argument("--address", default=None)

@@ -225,20 +225,44 @@ class ServeController:
         # Scale up.
         while len(st.replicas) < st.target:
             self._start_replica(name, st)
-        # Promote replicas whose ready() resolved.
+        # Promote replicas whose ready() resolved. wait/get are synchronous
+        # cluster RPCs; even a timeout=0 poll pays a controller round trip,
+        # so both hop through the executor — this loop shares the actor's
+        # event loop with the long-poll handlers and health replies.
         for rid, r in list(st.replicas.items()):
             if not r["ready"] and r["ready_ref"] is not None:
-                done, _ = ray_tpu.wait([r["ready_ref"]], num_returns=1, timeout=0)
-                if done:
-                    try:
-                        ray_tpu.get(done[0], timeout=1)
-                        r["ready"] = True
-                        r["ready_ref"] = None
-                        self._bump()
-                    except Exception as e:
-                        logger.warning("serve: replica %s failed to start: %r",
-                                       rid, e)
-                        st.replicas.pop(rid, None)
+                done, _ = await self._async_wait([r["ready_ref"]])
+                if not done:
+                    continue
+                err = None
+                try:
+                    await self._async_get(done[0], timeout=1)
+                except Exception as e:
+                    err = e
+                if self.deployments.get(name) is not st:
+                    # Superseded mid-await: st.replicas may now BE the
+                    # retire set deploy() parked in _retire_after_ready —
+                    # popping a failed replica from it here would exempt
+                    # that actor from the retire sweep and leak it.
+                    return
+                if err is None:
+                    r["ready"] = True
+                    r["ready_ref"] = None
+                    self._bump()
+                else:
+                    logger.warning("serve: replica %s failed to start: %r",
+                                   rid, err)
+                    st.replicas.pop(rid, None)
+        # The executor hops above are suspension points the old sync
+        # wait/get never had: a deploy() landing mid-await swaps
+        # self.deployments[name] to a NEW generation's state and points
+        # _retire_after_ready at the generation WE hold. Running the
+        # retire/scale-down logic against the stale st would count the old
+        # generation's own replicas as "the new one is ready" and stop it
+        # before its successor serves — bail out and let the next tick
+        # reconcile the live state.
+        if self.deployments.get(name) is not st:
+            return
         # Finish a rolling update: retire the old generation once the new
         # one is fully ready.
         old = self._retire_after_ready.get(name)
@@ -351,3 +375,11 @@ class ServeController:
         """Await an ObjectRef without blocking the actor event loop."""
         loop = asyncio.get_event_loop()
         return await loop.run_in_executor(None, lambda: ray_tpu.get(ref, timeout=timeout))
+
+    @staticmethod
+    async def _async_wait(refs, num_returns: int = 1, timeout: float = 0):
+        """Poll ObjectRef readiness without blocking the actor event loop."""
+        loop = asyncio.get_event_loop()
+        return await loop.run_in_executor(
+            None, lambda: ray_tpu.wait(refs, num_returns=num_returns,
+                                       timeout=timeout))

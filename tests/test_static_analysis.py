@@ -1,0 +1,675 @@
+"""tools/rtcheck: the invariant-encoding static analysis suite.
+
+Per pass: one minimal bad fixture that MUST produce the finding and its
+fixed twin that MUST be clean — the checker's contract is exactly "this
+bug class cannot land silently". Plus the tier-1 gate: rtcheck over the
+real tree (ray_tpu/ + tools/) is clean against an empty baseline and stays
+under the 10s budget (warm runs ride the per-file content-hash cache).
+"""
+
+import json
+import os
+import sys
+import textwrap
+import time
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from tools.rtcheck import core  # noqa: E402
+from tools.rtcheck.passes.async_blocking import AsyncBlockingPass  # noqa: E402
+from tools.rtcheck.passes.exception_taxonomy import ExceptionTaxonomyPass  # noqa: E402
+from tools.rtcheck.passes.knob_registry import KnobRegistryPass  # noqa: E402
+from tools.rtcheck.passes.lock_discipline import LockDisciplinePass  # noqa: E402
+from tools.rtcheck.passes.wire_schema import WireSchemaPass  # noqa: E402
+
+
+def run_fixture(tmp_path, files: dict, passes, roots=("ray_tpu",)):
+    """Materialize a mini-repo and run the given passes over it."""
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return core.run(roots, root=str(tmp_path), use_cache=False,
+                    baseline_path=str(tmp_path / "no_baseline.json"),
+                    passes=passes)
+
+
+def messages(res):
+    return [f.render() for f in res.findings]
+
+
+# ----------------------------------------------------------- async-blocking
+BAD_ASYNC = {
+    "ray_tpu/_private/svc.py": """
+        import time
+
+        async def handler():
+            time.sleep(0.1)
+
+        async def reader(path):
+            f = open(path)
+            return f.read()
+
+        async def locked(self):
+            self._lock.acquire()
+
+        def sync_helper():
+            time.sleep(0.1)  # sync function: allowed
+    """,
+}
+
+GOOD_ASYNC = {
+    "ray_tpu/_private/svc.py": """
+        import asyncio
+        import time
+
+        async def handler():
+            await asyncio.sleep(0.1)
+
+        async def reader(path):
+            def _read():
+                with open(path) as f:  # nested sync closure: executor-side
+                    return f.read()
+            return await asyncio.get_running_loop().run_in_executor(
+                None, _read)
+
+        async def locked(self):
+            if not self._lock.acquire(timeout=1.0):
+                raise TimeoutError
+    """,
+}
+
+
+def test_async_blocking_bad(tmp_path):
+    res = run_fixture(tmp_path, BAD_ASYNC, [AsyncBlockingPass()])
+    msgs = "\n".join(messages(res))
+    assert "time.sleep" in msgs
+    assert "open()" in msgs
+    assert "acquire" in msgs
+    # 4: sleep, open, the follow-on f.read() on the opened handle, acquire;
+    # sync_helper stays clean.
+    assert len(res.findings) == 4, msgs
+
+
+def test_async_blocking_good(tmp_path):
+    res = run_fixture(tmp_path, GOOD_ASYNC, [AsyncBlockingPass()])
+    assert res.ok, messages(res)
+
+
+def test_directive_inside_string_does_not_suppress(tmp_path):
+    """Directives count only as real comments: a string literal that
+    happens to contain the suppression syntax (help text, docs) must not
+    disable the gate for its neighbors."""
+    files = {
+        "ray_tpu/_private/svc.py": '''
+            import time
+
+            async def handler():
+                doc = "example: # rtcheck: disable=async-blocking"
+                time.sleep(0.1)
+                return doc
+        ''',
+    }
+    res = run_fixture(tmp_path, files, [AsyncBlockingPass()])
+    assert len(res.findings) == 1, messages(res)
+
+
+def test_async_blocking_suppression(tmp_path):
+    files = {
+        "ray_tpu/_private/svc.py": """
+            import time
+
+            async def handler():
+                # deliberate: sub-ms sleep in a test-only shim
+                time.sleep(0.001)  # rtcheck: disable=async-blocking
+        """,
+    }
+    res = run_fixture(tmp_path, files, [AsyncBlockingPass()])
+    assert res.ok, messages(res)
+
+
+# -------------------------------------------------------------- wire-schema
+BAD_WIRE = {
+    "ray_tpu/_private/proto.py": """
+        def encode(x):
+            return (x.a, x.b, x.c, x.d)  # rtcheck: wire=test.rec
+
+        def decode(t):
+            a, b, c = t  # rtcheck: wire=test.rec
+            return a
+
+        class S:
+            def __getstate__(self):
+                return (self.a, self.b, self.c, self.d, self.e)
+
+            def __setstate__(self, s):
+                if len(s) == 3:  # old snapshots — but arity 4 has no branch
+                    s = s + (None, None)
+                (self.a, self.b, self.c, self.d, self.e) = s
+    """,
+}
+
+GOOD_WIRE = {
+    "ray_tpu/_private/proto.py": """
+        def encode(x):
+            return (x.a, x.b, x.c, x.d)  # rtcheck: wire=test.rec
+
+        def decode(t, args=()):
+            if len(args) == 9:  # unrelated guard: must not register as a
+                return None     # back-compat branch (no [3,4,9] gap)
+            if len(t) == 3:  # pre-'d' wire records
+                t = t + (None,)
+            a, b, c, d = t  # rtcheck: wire=test.rec
+            return a
+
+        class S:
+            def __getstate__(self):
+                return (self.a, self.b, self.c, self.d, self.e)
+
+            def __setstate__(self, s):
+                if len(s) == 3:
+                    s = s + (None,)
+                if len(s) == 4:
+                    s = s + (None,)
+                (self.a, self.b, self.c, self.d, self.e) = s
+    """,
+}
+
+
+def test_wire_schema_bad(tmp_path):
+    res = run_fixture(tmp_path, BAD_WIRE, [WireSchemaPass()])
+    msgs = "\n".join(messages(res))
+    assert "decoder unpacks 3" in msgs and "encoder builds 4" in msgs
+    assert "back-compat gap" in msgs, msgs
+
+
+def test_wire_schema_good(tmp_path):
+    res = run_fixture(tmp_path, GOOD_WIRE, [WireSchemaPass()])
+    assert res.ok, messages(res)
+
+
+def test_wire_schema_branch_on_new_arity_is_finding_not_crash(tmp_path):
+    """A back-compat branch on the CURRENT (or larger) arity — the
+    branched-on-the-new-size typo — is a finding, never an IndexError that
+    takes down the whole lint run."""
+    files = {
+        "ray_tpu/_private/proto.py": """
+            def encode(x):
+                return (x.a, x.b, x.c)  # rtcheck: wire=test.rec
+
+            def decode(t):
+                if len(t) == 6:  # typo: branched on a size we never reach
+                    t = t + (None,)
+                a, b, c = t  # rtcheck: wire=test.rec
+                return a
+        """,
+    }
+    res = run_fixture(tmp_path, files, [WireSchemaPass()])
+    msgs = "\n".join(messages(res))
+    assert "not below the decoder's arity" in msgs, msgs
+
+
+def test_wire_schema_file_scoped_invocation(tmp_path):
+    """Scanning only task_spec.py on the real tree must not report phantom
+    marker deletion for wires whose markers live in other files."""
+    res = core.run(("ray_tpu/_private/task_spec.py",), root=REPO_ROOT,
+                   use_cache=False, passes=[WireSchemaPass()])
+    assert res.ok, messages(res)
+
+
+def test_wire_schema_half_marked(tmp_path):
+    # Deleting the consumer's marker (or the consumer) is itself a finding.
+    files = {
+        "ray_tpu/_private/proto.py": """
+            def encode(x):
+                return (x.a, x.b)  # rtcheck: wire=test.rec
+        """,
+    }
+    res = run_fixture(tmp_path, files, [WireSchemaPass()])
+    assert any("no marked consumer" in m for m in messages(res))
+
+
+# ------------------------------------------------------------ knob-registry
+MINI_RTCONFIG = """
+    _REGISTRY = {}
+
+    def _flag(name, typ, default):
+        _REGISTRY[name] = (typ, default)
+
+    _flag("foo_knob", int, 1)
+"""
+
+BAD_KNOBS = {
+    "ray_tpu/_private/rtconfig.py": MINI_RTCONFIG,
+    "ray_tpu/util/thing.py": """
+        import os
+
+        UNREGISTERED = os.environ.get("RT_BAR_KNOB", "")
+        BYPASS = os.environ.get("RT_FOO_KNOB")
+    """,
+    "README.md": "no knob table here\n",
+}
+
+GOOD_KNOBS = {
+    "ray_tpu/_private/rtconfig.py": MINI_RTCONFIG,
+    "ray_tpu/util/thing.py": """
+        from ray_tpu._private.rtconfig import CONFIG
+
+        def foo():
+            return CONFIG.foo_knob
+    """,
+    "README.md": "| `RT_FOO_KNOB` | 1 | the foo knob |\n",
+}
+
+
+def test_knob_registry_bad(tmp_path):
+    res = run_fixture(tmp_path, BAD_KNOBS, [KnobRegistryPass()])
+    msgs = "\n".join(messages(res))
+    assert "RT_BAR_KNOB is not a registered rtconfig flag" in msgs
+    assert "direct env read of RT_FOO_KNOB bypasses" in msgs
+    assert "missing from the README knob table" in msgs, msgs
+
+
+def test_knob_registry_good(tmp_path):
+    res = run_fixture(tmp_path, GOOD_KNOBS, [KnobRegistryPass()])
+    assert res.ok, messages(res)
+
+
+def test_knob_registry_dict_key_is_write(tmp_path):
+    """An RT_* key in a dict literal (spawn-env mapping for a child) is a
+    write-class usage: unregistered names get the register-it message."""
+    files = dict(GOOD_KNOBS)
+    files["ray_tpu/util/spawn.py"] = """
+        def child_env():
+            return {"RT_UNKNOWN_CHILD_KNOB": "1"}
+    """
+    res = run_fixture(tmp_path, files, [KnobRegistryPass()])
+    msgs = "\n".join(messages(res))
+    assert "RT_UNKNOWN_CHILD_KNOB is not a registered rtconfig flag" in msgs
+    files["ray_tpu/util/spawn.py"] = """
+        def child_env():
+            return {"RT_FOO_KNOB": "1"}
+    """
+    res = run_fixture(tmp_path, files, [KnobRegistryPass()])
+    assert res.ok, messages(res)
+
+
+def test_knob_registry_allowlist(tmp_path):
+    files = dict(GOOD_KNOBS)
+    files["ray_tpu/util/boot.py"] = """
+        import os
+
+        ADDR = os.environ.get("RT_ADDRESS")
+    """
+    res = run_fixture(tmp_path, files, [KnobRegistryPass()])
+    assert res.ok, messages(res)
+
+
+# ---------------------------------------------------------- lock-discipline
+BAD_LOCKS = {
+    "ray_tpu/util/locky.py": """
+        import threading
+
+        class Crossed:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+
+            def m1(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+
+            def m2(self):
+                with self._b_lock:
+                    with self._a_lock:
+                        pass
+
+        class HalfLocked:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._buf = []
+                self._t = threading.Thread(target=self._run, daemon=True)
+
+            def _run(self):
+                with self._lock:
+                    self._buf = []
+
+            def add(self, item):
+                self._buf = self._buf + [item]
+    """,
+}
+
+GOOD_LOCKS = {
+    "ray_tpu/util/locky.py": """
+        import threading
+
+        class Ordered:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+
+            def m1(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+
+            def m2(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+
+        class FullyLocked:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._buf = []
+                self._t = threading.Thread(target=self._run, daemon=True)
+
+            def _run(self):
+                with self._lock:
+                    self._buf = []
+
+            def add(self, item):
+                with self._lock:
+                    self._buf = self._buf + [item]
+    """,
+}
+
+
+def test_lock_discipline_bad(tmp_path):
+    res = run_fixture(tmp_path, BAD_LOCKS, [LockDisciplinePass()])
+    msgs = "\n".join(messages(res))
+    assert "lock acquisition cycle" in msgs, msgs
+    assert "HalfLocked._buf" in msgs and "without one in `add`" in msgs, msgs
+
+
+def test_lock_discipline_good(tmp_path):
+    res = run_fixture(tmp_path, GOOD_LOCKS, [LockDisciplinePass()])
+    assert res.ok, messages(res)
+
+
+def test_lock_discipline_module_scope(tmp_path):
+    # The metrics-flusher / checkpoint-writer shape: module-level lock,
+    # module globals, helper thread started from a module function.
+    bad = {
+        "ray_tpu/util/flushy.py": """
+            import threading
+
+            _lock = threading.Lock()
+            _pending = []
+
+            def _loop():
+                global _pending
+                while True:
+                    with _lock:
+                        _pending = []
+
+            def start():
+                threading.Thread(target=_loop, daemon=True).start()
+
+            def add(item):
+                global _pending
+                _pending = _pending + [item]
+        """,
+    }
+    res = run_fixture(tmp_path, bad, [LockDisciplinePass()])
+    msgs = "\n".join(messages(res))
+    assert "module global `_pending`" in msgs, msgs
+
+    good = {
+        "ray_tpu/util/flushy.py": """
+            import threading
+
+            _lock = threading.Lock()
+            _pending = []
+
+            def _loop():
+                global _pending
+                while True:
+                    with _lock:
+                        _pending = []
+
+            def start():
+                threading.Thread(target=_loop, daemon=True).start()
+
+            def add(item):
+                global _pending
+                with _lock:
+                    _pending = _pending + [item]
+        """,
+    }
+    res = run_fixture(tmp_path, good, [LockDisciplinePass()])
+    assert res.ok, messages(res)
+
+
+# ------------------------------------------------------- exception-taxonomy
+BAD_EXC = {
+    "ray_tpu/exceptions.py": """
+        class TaskError(Exception):
+            pass
+    """,
+    "ray_tpu/_private/svc.py": """
+        class PrivateWeirdError(Exception):
+            pass
+
+        class Svc:
+            async def _h_get(self, a):
+                raise PrivateWeirdError("off-taxonomy")
+
+        def hot_path():
+            try:
+                work()
+            except:
+                pass
+
+        def wedge():
+            try:
+                work()
+            except BaseException:
+                pass
+    """,
+}
+
+GOOD_EXC = {
+    "ray_tpu/exceptions.py": """
+        class TaskError(Exception):
+            pass
+    """,
+    "ray_tpu/_private/svc.py": """
+        from ray_tpu import exceptions as exc
+
+        class Svc:
+            async def _h_get(self, a):
+                raise exc.TaskError("in taxonomy")
+
+            async def _h_put(self, a):
+                raise ValueError("builtins are picklable everywhere")
+
+        def hot_path():
+            try:
+                work()
+            except Exception:
+                pass
+
+        def error_blob():
+            try:
+                work()
+            except BaseException as e:  # used: packaged into the blob
+                return {"error": repr(e)}
+    """,
+}
+
+
+def test_exception_taxonomy_bad(tmp_path):
+    res = run_fixture(tmp_path, BAD_EXC, [ExceptionTaxonomyPass()])
+    msgs = "\n".join(messages(res))
+    assert "bare `except:`" in msgs
+    assert "`except BaseException:`" in msgs
+    assert "raises PrivateWeirdError" in msgs, msgs
+
+
+def test_exception_taxonomy_good(tmp_path):
+    res = run_fixture(tmp_path, GOOD_EXC, [ExceptionTaxonomyPass()])
+    assert res.ok, messages(res)
+
+
+# ----------------------------------------------------- baseline + machinery
+def test_baseline_grandfathers_finding(tmp_path):
+    for rel, src in BAD_ASYNC.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    first = core.run(("ray_tpu",), root=str(tmp_path), use_cache=False,
+                     baseline_path=str(tmp_path / "none.json"),
+                     passes=[AsyncBlockingPass()])
+    assert first.findings
+    baseline = {"findings": [{"key": f.key, "reason": "grandfathered"}
+                             for f in first.findings]}
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps(baseline))
+    second = core.run(("ray_tpu",), root=str(tmp_path), use_cache=False,
+                      baseline_path=str(bl), passes=[AsyncBlockingPass()])
+    assert second.ok
+    assert len(second.baselined) == len(first.findings)
+    assert not second.stale_baseline
+
+
+def test_cache_hits_on_unchanged_files(tmp_path, monkeypatch):
+    monkeypatch.setenv("RTCHECK_CACHE_DIR", str(tmp_path / "cache"))
+    for rel, src in GOOD_ASYNC.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    cold = core.run(("ray_tpu",), root=str(tmp_path),
+                    baseline_path=str(tmp_path / "none.json"))
+    warm = core.run(("ray_tpu",), root=str(tmp_path),
+                    baseline_path=str(tmp_path / "none.json"))
+    assert cold.cached_files == 0
+    assert warm.cached_files == warm.files == cold.files
+    assert warm.ok == cold.ok
+
+
+def test_duplicate_files_do_not_alias_in_cache(tmp_path, monkeypatch):
+    """Byte-identical files each report their own findings at their own
+    path (the cache keys by path+sha, not sha alone)."""
+    monkeypatch.setenv("RTCHECK_CACHE_DIR", str(tmp_path / "cache"))
+    src = """
+        def f():
+            try:
+                g()
+            except:
+                pass
+    """
+    files = {"ray_tpu/_private/a.py": src, "ray_tpu/_private/b.py": src}
+    for rel, s in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(s))
+    res = core.run(("ray_tpu",), root=str(tmp_path),
+                   baseline_path=str(tmp_path / "none.json"),
+                   passes=[ExceptionTaxonomyPass()])
+    assert sorted(f.path for f in res.findings) == [
+        "ray_tpu/_private/a.py", "ray_tpu/_private/b.py"]
+
+
+def test_duplicate_message_keys_get_ordinals(tmp_path):
+    """Two identical violations in one file have distinct baseline keys —
+    baselining the first must not grandfather a second (or a future third)."""
+    files = {
+        "ray_tpu/_private/a.py": """
+            def f():
+                try:
+                    g()
+                except:
+                    pass
+                try:
+                    h()
+                except:
+                    pass
+        """,
+    }
+    res = run_fixture(tmp_path, files, [ExceptionTaxonomyPass()])
+    keys = [f.key for f in res.findings]
+    assert len(keys) == 2 and len(set(keys)) == 2, keys
+    bl = tmp_path / "baseline.json"
+    bl.write_text(json.dumps(
+        {"findings": [{"key": keys[0], "reason": "grandfathered"}]}))
+    res2 = core.run(("ray_tpu",), root=str(tmp_path), use_cache=False,
+                    baseline_path=str(bl), passes=[ExceptionTaxonomyPass()])
+    assert len(res2.findings) == 1 and len(res2.baselined) == 1
+
+
+def test_finalize_findings_honor_suppressions(tmp_path):
+    """Cross-file (finalize) findings respect inline suppressions at the
+    attributed site — e.g. a deliberate wire-arity skew."""
+    files = {
+        "ray_tpu/_private/proto.py": """
+            def encode(x):
+                return (x.a, x.b, x.c)  # rtcheck: wire=test.rec
+
+            def decode_prefix(t):
+                # reads only the stable prefix, by design
+                # rtcheck: disable=wire-schema
+                a, b = t  # rtcheck: wire=test.rec
+                return a
+        """,
+    }
+    res = run_fixture(tmp_path, files, [WireSchemaPass()])
+    assert res.ok, messages(res)
+
+
+def test_missing_root_is_a_finding(tmp_path):
+    """A typo'd analysis root must fail, not silently pass a 0-file run."""
+    (tmp_path / "ray_tpu").mkdir()
+    res = core.run(("ray_tpu", "prvate_typo"), root=str(tmp_path),
+                   use_cache=False,
+                   baseline_path=str(tmp_path / "none.json"), passes=[])
+    assert not res.ok
+    assert any("does not exist" in f.message for f in res.findings)
+
+
+def test_restricted_roots_stay_clean(tmp_path):
+    """`rtcheck ray_tpu/serve` on the real tree must not invent findings
+    about files it never scanned (registry/taxonomy anchors come from disk,
+    required-wire markers are skipped)."""
+    res = core.run(("ray_tpu/serve",), root=REPO_ROOT, use_cache=False)
+    assert res.ok, messages(res)
+
+
+# -------------------------------------------------------------- tier-1 gate
+def test_rtcheck_repo_clean_under_budget():
+    """The tree itself: zero non-baselined findings, and the whole run —
+    cold or warm — fits the 10s tier-1 budget (warm runs are ~10ms via the
+    content-hash cache)."""
+    t0 = time.monotonic()
+    res = core.run(core.DEFAULT_ROOTS, root=REPO_ROOT, use_cache=True)
+    elapsed = time.monotonic() - t0
+    assert res.ok, "rtcheck findings on the tree:\n" + "\n".join(
+        f.render() for f in res.findings)
+    assert elapsed < 10.0, f"rtcheck took {elapsed:.1f}s (budget 10s)"
+    assert res.files > 100  # sanity: it actually scanned the tree
+
+
+def test_rtcheck_cli_json():
+    """`ray-tpu lint --json` / `python -m tools.rtcheck --json` contract."""
+    import io
+    from contextlib import redirect_stdout
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = core.main(["--json"])
+    out = json.loads(buf.getvalue())
+    assert rc == 0
+    assert out["ok"] is True
+    assert out["files"] > 100
+    assert isinstance(out["findings"], list)
+
+
+def test_every_pass_registered():
+    ids = {p.id for p in core.all_passes()}
+    assert ids == {"async-blocking", "wire-schema", "knob-registry",
+                   "lock-discipline", "exception-taxonomy"}

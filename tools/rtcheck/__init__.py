@@ -1,0 +1,11 @@
+"""rtcheck — invariant-encoding static analysis for the ray_tpu runtime.
+
+Run as `python -m tools.rtcheck` or `ray-tpu lint`. See core.py for the
+framework and passes/ for the five invariant passes.
+"""
+
+from tools.rtcheck.core import (DEFAULT_ROOTS, Finding, Pass, RunResult,
+                                all_passes, load_baseline, main, run)
+
+__all__ = ["DEFAULT_ROOTS", "Finding", "Pass", "RunResult", "all_passes",
+           "load_baseline", "main", "run"]

@@ -1,0 +1,93 @@
+"""Small AST conveniences shared by the rtcheck passes."""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, None for anything dynamic."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def terminal_name(node: ast.AST) -> Optional[str]:
+    """Last path component of a Name/Attribute chain ('c' for a.b.c)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def call_keywords(call: ast.Call) -> set[str]:
+    return {kw.arg for kw in call.keywords if kw.arg is not None}
+
+
+class FunctionStackVisitor(ast.NodeVisitor):
+    """Tracks the enclosing function/class stack while walking. Subclasses
+    read `self.func_stack` ([(is_async, name), ...] innermost last) and
+    `self.class_stack`."""
+
+    def __init__(self):
+        self.func_stack: list[tuple[bool, str]] = []
+        self.class_stack: list[str] = []
+
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        self.func_stack.append((False, node.name))
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef):
+        self.func_stack.append((True, node.name))
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    def visit_Lambda(self, node: ast.Lambda):
+        self.func_stack.append((False, "<lambda>"))
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    def visit_ClassDef(self, node: ast.ClassDef):
+        self.class_stack.append(node.name)
+        self.generic_visit(node)
+        self.class_stack.pop()
+
+    def in_async_body(self) -> bool:
+        """True when the innermost enclosing function is an `async def`
+        (code inside a nested sync closure runs wherever the closure is
+        called — usually an executor thread — so it doesn't count)."""
+        return bool(self.func_stack) and self.func_stack[-1][0]
+
+
+def statement_at(tree: ast.AST, line: int) -> Optional[ast.stmt]:
+    """Smallest statement whose source span covers `line`."""
+    best: Optional[ast.stmt] = None
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.stmt):
+            continue
+        end = getattr(node, "end_lineno", node.lineno)
+        if node.lineno <= line <= end:
+            if best is None or (node.lineno, -end) > (best.lineno,
+                                                      -getattr(best, "end_lineno", best.lineno)):
+                best = node
+    return best
+
+
+def enclosing_function(tree: ast.AST, line: int):
+    """Innermost (Async)FunctionDef whose span covers `line`."""
+    best = None
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            end = getattr(node, "end_lineno", node.lineno)
+            if node.lineno <= line <= end:
+                if best is None or node.lineno > best.lineno:
+                    best = node
+    return best

@@ -1,0 +1,498 @@
+"""rtcheck — AST-based invariant checker for the ray_tpu runtime.
+
+The runtime encodes invariants its C++ reference enforces with types and
+clang-tidy; a Python rebuild enforces them only by reviewer vigilance.
+rtcheck turns the recurring invariant classes into CI-failing passes:
+
+  async-blocking      event-loop hot paths must never block
+  wire-schema         compact wire tuples: encoder/decoder arity agreement
+                      + back-compat branches on growth
+  knob-registry       every RT_* env literal resolves to a registered
+                      rtconfig flag (or the bootstrap allowlist), and every
+                      registered flag is documented in the README
+  lock-discipline     lock acquisition order is acyclic; helper-thread
+                      classes don't mutate shared attrs half-locked
+  exception-taxonomy  no swallowed bare/overbroad excepts in _private/ hot
+                      paths; RPC handlers raise only taxonomy exceptions
+
+Framework pieces here: the Finding model, inline `# rtcheck: disable=<pass>`
+suppressions, the checked-in baseline (grandfathered findings), a per-file
+content-hash result cache, and the runner/CLI (`python -m tools.rtcheck`,
+`ray-tpu lint`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import hashlib
+import json
+import os
+import re
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+BASELINE_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "baseline.json")
+
+#: Default analysis roots, repo-relative (the tier-1 gate runs exactly these).
+DEFAULT_ROOTS = ("ray_tpu", "tools")
+
+_SUPPRESS_RE = re.compile(r"#\s*rtcheck:\s*disable=([\w\-,\s]+)")
+_SUPPRESS_FILE_RE = re.compile(r"#\s*rtcheck:\s*disable-file=([\w\-,\s]+)")
+
+
+def _comment_map(source: str) -> dict[int, str]:
+    """line -> comment token text, via the tokenizer (so a '#' inside a
+    string literal is never mistaken for a comment)."""
+    import io
+    import tokenize
+
+    out: dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        pass  # ast.parse will surface real syntax problems
+    return out
+
+
+@dataclass
+class Finding:
+    """One invariant violation at file:line, attributed to a pass id."""
+
+    pass_id: str
+    path: str  # repo-relative
+    line: int
+    message: str
+    col: int = 0
+    #: 1-based occurrence index among same-keyed findings in one run,
+    #: assigned by the runner in deterministic (path, line, pass) order.
+    occurrence: int = 1
+
+    @property
+    def key(self) -> str:
+        """Stable baseline key. The message (not the line) anchors it, so
+        unrelated edits above a grandfathered finding don't churn the
+        baseline; repeats of one message in one file get an ordinal suffix
+        (:2, :3, ...) so baselining the first does NOT grandfather a new
+        identical violation added later."""
+        digest = hashlib.sha1(self.message.encode()).hexdigest()[:12]
+        base = f"{self.pass_id}:{self.path}:{digest}"
+        return base if self.occurrence == 1 else f"{base}:{self.occurrence}"
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.pass_id}] {self.message}"
+
+    def to_json(self) -> dict:
+        return {"pass": self.pass_id, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message, "key": self.key}
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Finding":
+        return cls(pass_id=d["pass"], path=d["path"], line=d["line"],
+                   col=d.get("col", 0), message=d["message"])
+
+
+class FileCtx:
+    """Parsed view of one source file handed to every per-file pass."""
+
+    def __init__(self, relpath: str, source: str):
+        self.path = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=relpath)
+        self.sha = hashlib.sha1(source.encode()).hexdigest()
+        #: line -> comment text (the `# ...` token only). Directives are
+        #: matched against REAL comments, never string literals — a string
+        #: documenting the suppression syntax must not disable the gate.
+        self.comments: dict[int, str] = _comment_map(source)
+        self._suppressed: dict[int, set[str]] = {}
+        self._file_suppressed: set[str] = set()
+        for i, ln in self.comments.items():
+            if "rtcheck:" not in ln:
+                continue
+            m = _SUPPRESS_RE.search(ln)
+            if m:
+                ids = {p.strip() for p in m.group(1).split(",") if p.strip()}
+                self._suppressed[i] = ids
+            m = _SUPPRESS_FILE_RE.search(ln)
+            if m and i <= 10:
+                self._file_suppressed |= {
+                    p.strip() for p in m.group(1).split(",") if p.strip()}
+
+    def suppressed(self, pass_id: str, line: int) -> bool:
+        """A finding is suppressed by `# rtcheck: disable=<pass>` on its own
+        line or the line directly above (for multi-line statements, anywhere
+        a comment can sit), or file-wide in the first 10 lines."""
+        # Hot path (queried per candidate site): match the parsed sets
+        # directly, don't rebuild the JSON table.
+        if (pass_id in self._file_suppressed
+                or "all" in self._file_suppressed):
+            return True
+        for ln in (line, line - 1):
+            ids = self._suppressed.get(ln)
+            if ids and (pass_id in ids or "all" in ids):
+                return True
+        return False
+
+    def suppression_table(self) -> dict:
+        """JSON-able suppression map — cached with per-file results so
+        finalize (cross-file) findings honor inline suppressions even when
+        the file itself came from the cache."""
+        return {"file": sorted(self._file_suppressed),
+                "lines": {str(k): sorted(v)
+                          for k, v in self._suppressed.items()}}
+
+
+def _suppr_match(table: dict, pass_id: str, line: int) -> bool:
+    fids = table.get("file", ())
+    if pass_id in fids or "all" in fids:
+        return True
+    lines = table.get("lines", {})
+    for ln in (line, line - 1):
+        ids = lines.get(str(ln))
+        if ids and (pass_id in ids or "all" in ids):
+            return True
+    return False
+
+
+class Pass:
+    """Base pass. Per-file analysis returns (findings, facts); facts are
+    JSON-serializable extracts that `finalize` joins across files (and that
+    the content-hash cache persists, so unchanged files contribute to
+    whole-program checks without reparsing)."""
+
+    id: str = ""
+
+    def wants(self, relpath: str) -> bool:
+        return True
+
+    def check_file(self, ctx: FileCtx) -> tuple[list[Finding], Any]:
+        return [], None
+
+    def finalize(self, facts: dict[str, Any],
+                 project: "Project") -> list[Finding]:
+        return []
+
+
+class Project:
+    """Whole-run context available to finalize passes (repo root access for
+    non-Python inputs like the README knob table). `analyzed` is the set of
+    repo-relative paths this run actually scanned — finalize passes use it
+    to degrade gracefully on restricted-root runs (e.g.
+    `rtcheck ray_tpu/serve`) instead of reporting their anchor files as
+    missing."""
+
+    def __init__(self, root: str):
+        self.root = root
+        self.analyzed: set[str] = set()
+
+    def read_text(self, relpath: str) -> Optional[str]:
+        try:
+            with open(os.path.join(self.root, relpath)) as f:
+                return f.read()
+        except OSError:
+            return None
+
+
+# --------------------------------------------------------------------- passes
+def all_passes() -> list[Pass]:
+    from tools.rtcheck.passes import (async_blocking, exception_taxonomy,
+                                      knob_registry, lock_discipline,
+                                      wire_schema)
+
+    return [async_blocking.AsyncBlockingPass(),
+            wire_schema.WireSchemaPass(),
+            knob_registry.KnobRegistryPass(),
+            lock_discipline.LockDisciplinePass(),
+            exception_taxonomy.ExceptionTaxonomyPass()]
+
+
+def _tool_version() -> str:
+    """Content hash of the checker itself: editing any pass invalidates
+    every cached result."""
+    h = hashlib.sha1()
+    tool_dir = os.path.dirname(os.path.abspath(__file__))
+    for dirpath, _dirs, files in sorted(os.walk(tool_dir)):
+        for name in sorted(files):
+            if name.endswith(".py"):
+                with open(os.path.join(dirpath, name), "rb") as f:
+                    h.update(f.read())
+    return h.hexdigest()[:16]
+
+
+# ---------------------------------------------------------------------- cache
+def _default_cache_path(root: str) -> str:
+    # User-owned cache home, NOT a predictable world-writable /tmp path: a
+    # squatted cache dir could feed back empty findings and silently
+    # disable the lint gate on shared machines.
+    tag = hashlib.sha1(root.encode()).hexdigest()[:12]
+    base = (os.environ.get("RTCHECK_CACHE_DIR")
+            or os.path.join(
+                os.environ.get("XDG_CACHE_HOME")
+                or os.path.join(os.path.expanduser("~"), ".cache"),
+                "rtcheck"))
+    return os.path.join(base, f"cache_{tag}.json")
+
+
+class ResultCache:
+    """Per-file findings+facts keyed by (source sha, tool version). The
+    tier-1 gate re-runs rtcheck every time; warm runs must stay well under
+    the 10s budget, so unchanged files skip parse AND analysis."""
+
+    def __init__(self, path: str, tool_version: str):
+        self.path = path
+        self.tool_version = tool_version
+        self._entries: dict[str, dict] = {}
+        self._seen: set[str] = set()  # keys touched this run
+        self._visited_paths: set[str] = set()  # relpaths scanned this run
+        self._dirty = False
+        try:
+            with open(path) as f:
+                data = json.load(f)
+            if data.get("tool") == tool_version:
+                self._entries = data.get("files", {})
+        except (OSError, ValueError):
+            pass
+
+    def visit(self, relpath: str) -> None:
+        self._visited_paths.add(relpath)
+
+    def get(self, key: str) -> Optional[dict]:
+        ent = self._entries.get(key)
+        if ent is not None:
+            self._seen.add(key)
+        return ent
+
+    def put(self, key: str, findings: list[Finding],
+            facts: dict[str, Any], suppression: dict) -> None:
+        self._entries[key] = {
+            "findings": [f.to_json() for f in findings],
+            "facts": facts,
+            "suppress": suppression,
+        }
+        self._seen.add(key)
+        self._dirty = True
+
+    def save(self) -> None:
+        # Evict superseded file versions: an unseen key whose relpath WAS
+        # scanned this run is a stale (relpath, sha) from an earlier edit —
+        # without this the cache grows by one blob per historical version.
+        # Entries for paths outside this run's roots stay (still live).
+        live = {k: v for k, v in self._entries.items()
+                if k in self._seen
+                or k.rsplit(":", 1)[0] not in self._visited_paths}
+        dropped = len(self._entries) - len(live)
+        if not self._dirty and not dropped:
+            return
+        try:
+            os.makedirs(os.path.dirname(self.path), exist_ok=True)
+            tmp = f"{self.path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump({"tool": self.tool_version, "files": live}, f)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass  # cache is best-effort; a cold run is merely slower
+
+
+# ------------------------------------------------------------------- baseline
+def load_baseline(path: str = BASELINE_PATH) -> dict[str, str]:
+    """key -> justification. Every baselined finding carries a reason; the
+    workflow is: land the checker with real findings grandfathered, burn the
+    baseline down, keep it empty."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    return {e["key"]: e.get("reason", "") for e in data.get("findings", [])}
+
+
+# --------------------------------------------------------------------- runner
+@dataclass
+class RunResult:
+    findings: list[Finding] = field(default_factory=list)  # non-baselined
+    baselined: list[Finding] = field(default_factory=list)
+    stale_baseline: list[str] = field(default_factory=list)
+    files: int = 0
+    cached_files: int = 0
+    elapsed_s: float = 0.0
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+
+def discover_files(root: str, roots=DEFAULT_ROOTS,
+                   missing: Optional[list[str]] = None) -> list[str]:
+    out = []
+    for r in roots:
+        top = os.path.join(root, r)
+        if os.path.isfile(top) and top.endswith(".py"):
+            out.append(os.path.relpath(top, root))
+            continue
+        if not os.path.isdir(top):
+            # A typo'd root in a CI invocation must FAIL, not report a
+            # clean 0-file run with the gate silently disabled.
+            if missing is not None:
+                missing.append(r)
+            continue
+        for dirpath, dirs, files in os.walk(top):
+            dirs[:] = sorted(d for d in dirs
+                             if d != "__pycache__" and not d.startswith("."))
+            for name in sorted(files):
+                if name.endswith(".py"):
+                    out.append(os.path.relpath(
+                        os.path.join(dirpath, name), root))
+    return out
+
+
+def run(roots=DEFAULT_ROOTS, *, root: str = REPO_ROOT,
+        use_cache: bool = True, baseline_path: str = BASELINE_PATH,
+        passes: Optional[list[Pass]] = None) -> RunResult:
+    t0 = time.monotonic()
+    passes = passes if passes is not None else all_passes()
+    project = Project(root)
+    cache = (ResultCache(_default_cache_path(root), _tool_version())
+             if use_cache else None)
+    res = RunResult()
+    facts: dict[str, dict[str, Any]] = {p.id: {} for p in passes}
+    suppressions: dict[str, dict] = {}  # relpath -> suppression table
+    per_file: list[Finding] = []
+    missing_roots: list[str] = []
+    for relpath in discover_files(root, roots, missing=missing_roots):
+        res.files += 1
+        try:
+            with open(os.path.join(root, relpath)) as f:
+                source = f.read()
+        except OSError:
+            continue
+        project.analyzed.add(relpath)
+        # Path rides in the key: byte-identical files must not alias each
+        # other's (path-bearing) findings.
+        cache_key = f"{relpath}:{hashlib.sha1(source.encode()).hexdigest()}"
+        if cache is not None:
+            cache.visit(relpath)
+        cached = cache.get(cache_key) if cache is not None else None
+        if cached is not None:
+            res.cached_files += 1
+            for d in cached["findings"]:
+                per_file.append(Finding.from_json(d))
+            for pid, fact in cached["facts"].items():
+                if pid in facts and fact is not None:
+                    facts[pid][relpath] = fact
+            suppressions[relpath] = cached.get("suppress", {})
+            continue
+        try:
+            ctx = FileCtx(relpath, source)
+        except SyntaxError as e:
+            per_file.append(Finding("rtcheck", relpath, e.lineno or 0,
+                                    f"syntax error: {e.msg}"))
+            continue
+        suppressions[relpath] = ctx.suppression_table()
+        file_findings: list[Finding] = []
+        file_facts: dict[str, Any] = {}
+        for p in passes:
+            if not p.wants(relpath):
+                continue
+            found, fact = p.check_file(ctx)
+            file_findings.extend(
+                f for f in found if not ctx.suppressed(f.pass_id, f.line))
+            file_facts[p.id] = fact
+            if fact is not None:
+                facts[p.id][relpath] = fact
+        per_file.extend(file_findings)
+        if cache is not None:
+            cache.put(cache_key, file_findings, file_facts,
+                      suppressions[relpath])
+    if cache is not None:
+        cache.save()
+
+    all_findings = list(per_file)
+    for r in missing_roots:
+        all_findings.append(Finding(
+            "rtcheck", r, 0,
+            f"analysis root '{r}' does not exist — fix the path or the "
+            f"invocation (a missing root must not pass as clean)"))
+    for p in passes:
+        # Cross-file findings honor inline suppressions too (the site's
+        # table survives caching).
+        for f in p.finalize(facts[p.id], project):
+            tbl = suppressions.get(f.path)
+            if tbl is not None and _suppr_match(tbl, f.pass_id, f.line):
+                continue
+            all_findings.append(f)
+
+    baseline = load_baseline(baseline_path)
+    seen_keys = set()
+    occurrences: dict[str, int] = {}
+    for f in sorted(all_findings, key=lambda f: (f.path, f.line, f.pass_id)):
+        f.occurrence = 1
+        base = f.key
+        f.occurrence = occurrences[base] = occurrences.get(base, 0) + 1
+        seen_keys.add(f.key)
+        if f.key in baseline:
+            res.baselined.append(f)
+        else:
+            res.findings.append(f)
+    res.stale_baseline = sorted(k for k in baseline if k not in seen_keys)
+    res.elapsed_s = time.monotonic() - t0
+    return res
+
+
+# ------------------------------------------------------------------------ CLI
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="rtcheck",
+        description="Static invariant checks for the ray_tpu runtime "
+                    "(also exposed as `ray-tpu lint`).")
+    ap.add_argument("paths", nargs="*", default=[],
+                    help=f"roots to analyze (default: {', '.join(DEFAULT_ROOTS)})")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output for tooling")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="ignore the per-file content-hash result cache")
+    ap.add_argument("--baseline", default=BASELINE_PATH,
+                    help="baseline file of grandfathered finding keys")
+    ap.add_argument("--list-passes", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_passes:
+        for p in all_passes():
+            print(f"{p.id}: {(p.__doc__ or '').strip().splitlines()[0]}")
+        return 0
+
+    roots = tuple(args.paths) or DEFAULT_ROOTS
+    res = run(roots, use_cache=not args.no_cache,
+              baseline_path=args.baseline)
+
+    if args.as_json:
+        print(json.dumps({
+            "ok": res.ok,
+            "findings": [f.to_json() for f in res.findings],
+            "baselined": [f.to_json() for f in res.baselined],
+            "stale_baseline": res.stale_baseline,
+            "files": res.files,
+            "cached_files": res.cached_files,
+            "elapsed_s": round(res.elapsed_s, 3),
+        }, indent=2))
+        return 0 if res.ok else 1
+
+    for f in res.findings:
+        print(f.render())
+    for key in res.stale_baseline:
+        print(f"warning: stale baseline entry (no longer found): {key}")
+    tail = (f"{res.files} files ({res.cached_files} cached), "
+            f"{len(res.findings)} finding(s), "
+            f"{len(res.baselined)} baselined, {res.elapsed_s:.2f}s")
+    if res.ok:
+        print(f"rtcheck: clean — {tail}")
+        return 0
+    print(f"rtcheck: FAILED — {tail}", file=sys.stderr)
+    return 1

@@ -1,0 +1,1 @@
+"""The five invariant passes. Imported lazily by core.all_passes()."""

@@ -1,0 +1,157 @@
+"""async-blocking: asyncio hot paths must never block the event loop.
+
+Every RPC frame, lease grant, heartbeat and scheduler pass in this runtime
+rides a handful of event loops (`rpc.EventLoopThread`, the controller/agent
+loops, serve's proxy loop). One blocking call inside an `async def` stalls
+every connection multiplexed onto that loop — the failure shows up as
+cluster-wide latency, not a local bug.
+
+Flags, inside `async def` bodies under ray_tpu/_private/ and ray_tpu/serve/
+(nested sync closures are exempt — they run wherever they're called, usually
+an executor thread):
+
+- `time.sleep(...)` (use `asyncio.sleep`)
+- blocking `subprocess` / `os.system` / `os.popen` calls
+- blocking `socket` module calls and recv/accept/connect on socket-ish names
+- synchronous file IO: builtin `open(...)` and `.read()/.readlines()/
+  .write()` on handles opened in the same async body
+- sync RPC bridges that would deadlock or stall the loop: `*.io.run(...)` /
+  `EventLoopThread.run`, non-awaited `ray_tpu.get/wait`, and
+  `concurrent.futures` `.result()`
+- `threading.Lock.acquire()` without a timeout (an unbounded sync lock wait
+  parks the whole loop; `with lock:` around short critical sections is fine
+  and deliberately not flagged)
+"""
+
+from __future__ import annotations
+
+import ast
+
+from tools.rtcheck.astutil import (FunctionStackVisitor, call_keywords,
+                                   dotted, terminal_name)
+from tools.rtcheck.core import FileCtx, Finding, Pass
+
+_TIME_MODULES = {"time", "_time"}
+_SUBPROCESS_FNS = {"run", "call", "check_call", "check_output", "Popen"}
+_SOCKET_MODULE_FNS = {"create_connection", "socketpair", "getaddrinfo",
+                      "gethostbyname", "socket"}
+_SOCKETISH_METHODS = {"recv", "recv_into", "accept", "connect", "sendall"}
+_FILE_READ_METHODS = {"read", "readline", "readlines", "write"}
+
+
+class AsyncBlockingPass(Pass):
+    """Flag blocking calls inside async def bodies on runtime hot paths."""
+
+    id = "async-blocking"
+
+    def wants(self, relpath: str) -> bool:
+        return ("ray_tpu/_private/" in relpath
+                or "ray_tpu/serve/" in relpath)
+
+    def check_file(self, ctx: FileCtx) -> tuple[list[Finding], None]:
+        v = _Visitor(ctx)
+        v.visit(ctx.tree)
+        return v.findings, None
+
+
+class _Visitor(FunctionStackVisitor):
+    def __init__(self, ctx: FileCtx):
+        super().__init__()
+        self.ctx = ctx
+        self.findings: list[Finding] = []
+        self._awaited: set[int] = set()
+        #: per-async-function names bound from open() (flow-lite: a handle
+        #: opened in this async body makes later .read()/.write() on that
+        #: name blocking too)
+        self._open_names: list[set[str]] = []
+
+    # -- track which Call nodes are directly awaited ------------------------
+    def visit_Await(self, node: ast.Await):
+        if isinstance(node.value, ast.Call):
+            self._awaited.add(id(node.value))
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef):
+        self._open_names.append(set())
+        super().visit_AsyncFunctionDef(node)
+        self._open_names.pop()
+
+    def visit_Assign(self, node: ast.Assign):
+        if (self.in_async_body() and self._open_names
+                and isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Name)
+                and node.value.func.id == "open"):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self._open_names[-1].add(t.id)
+        self.generic_visit(node)
+
+    def _flag(self, node: ast.AST, what: str, fix: str):
+        self.findings.append(Finding(
+            AsyncBlockingPass.id, self.ctx.path, node.lineno,
+            f"blocking {what} inside `async def "
+            f"{self.func_stack[-1][1]}` — {fix}",
+            col=node.col_offset))
+
+    def visit_Call(self, node: ast.Call):
+        if not self.in_async_body() or id(node) in self._awaited:
+            self.generic_visit(node)
+            return
+        func = node.func
+        chain = dotted(func)
+        name = terminal_name(func)
+
+        # time.sleep — the classic loop stall.
+        if chain is not None and "." in chain:
+            mod, _, attr = chain.rpartition(".")
+            if attr == "sleep" and mod.split(".")[-1] in _TIME_MODULES:
+                self._flag(node, "time.sleep()", "use `await asyncio.sleep`")
+            elif (attr in _SUBPROCESS_FNS
+                  and mod.split(".")[-1] in ("subprocess", "_subprocess")):
+                self._flag(node, f"subprocess.{attr}()",
+                           "use `asyncio.create_subprocess_exec` or "
+                           "run_in_executor")
+            elif mod.split(".")[-1] == "os" and attr in ("system", "popen"):
+                self._flag(node, f"os.{attr}()", "use run_in_executor")
+            elif (attr in _SOCKET_MODULE_FNS
+                  and mod.split(".")[-1] == "socket"):
+                self._flag(node, f"socket.{attr}()",
+                           "use asyncio streams or run_in_executor")
+            elif (attr in _SOCKETISH_METHODS
+                  and "sock" in mod.split(".")[-1].lower()):
+                self._flag(node, f"socket .{attr}()",
+                           "use asyncio streams or run_in_executor")
+            elif attr == "run" and mod.split(".")[-1] in ("io", "_io_thread"):
+                # EventLoopThread.run() bridges sync->async by BLOCKING on a
+                # concurrent future; called from a coroutine it stalls (or
+                # deadlocks) the loop.
+                self._flag(node, "EventLoopThread.run()",
+                           "await the coroutine directly")
+            elif (attr in ("get", "wait")
+                  and mod.split(".")[-1] == "ray_tpu"):
+                self._flag(node, f"ray_tpu.{attr}()",
+                           "synchronous cluster RPC from a coroutine; move "
+                           "to a thread or use the async object APIs")
+            elif attr == "result" and mod.split(".")[-1] in (
+                    "fut", "future", "cf"):
+                self._flag(node, "Future.result()",
+                           "await `asyncio.wrap_future(fut)` instead")
+            elif attr == "acquire" and "lock" in mod.split(".")[-1].lower():
+                kws = call_keywords(node)
+                if ("timeout" not in kws and "blocking" not in kws
+                        and not node.args):
+                    self._flag(node, "Lock.acquire() without timeout",
+                               "bound it with `timeout=` or restructure; an "
+                               "unbounded sync lock wait parks the loop")
+        elif name == "open":
+            self._flag(node, "open()",
+                       "synchronous file IO; use run_in_executor")
+        # .read()/.write() on a handle opened in this async body.
+        if (isinstance(func, ast.Attribute)
+                and func.attr in _FILE_READ_METHODS
+                and isinstance(func.value, ast.Name)
+                and self._open_names
+                and func.value.id in self._open_names[-1]):
+            self._flag(node, f"file .{func.attr}()",
+                       "synchronous file IO; use run_in_executor")
+        self.generic_visit(node)

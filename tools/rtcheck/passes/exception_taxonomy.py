@@ -1,0 +1,178 @@
+"""exception-taxonomy: no swallowed overbroad excepts in _private/ hot
+paths; RPC handlers raise only taxonomy exceptions.
+
+Two invariants:
+
+1. **Swallowed overbroad handlers** in ray_tpu/_private/: a bare `except:`
+   is always flagged (it eats KeyboardInterrupt/SystemExit — on the worker
+   exec path that breaks cancel/timeout delivery, which rides SIGINT). An
+   `except BaseException:` is flagged when it *swallows*: no re-raise and
+   the bound exception (if any) is never used — catching user-code errors
+   into an error blob is legitimate and stays clean.
+
+2. **RPC handler raise taxonomy**: controller `_h_*`/`_p_*` handlers (and
+   `_on_request` dispatchers) reply across the wire; whatever they raise is
+   re-surfaced in another process. Raising module-local exception classes
+   couples peers to private modules and breaks unpickling on version skew —
+   handlers may only raise classes from `ray_tpu.exceptions`, the rpc
+   transport errors, or stdlib builtins (picklable everywhere). The
+   taxonomy is read from the AST of ray_tpu/exceptions.py + rpc.py, so
+   adding a class there extends the allowed set automatically.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+from typing import Any
+
+from tools.rtcheck.astutil import terminal_name
+from tools.rtcheck.core import FileCtx, Finding, Pass
+
+_ID = "exception-taxonomy"
+_TAXONOMY_FILES = ("ray_tpu/exceptions.py", "ray_tpu/_private/rpc.py")
+_BUILTIN_EXCS = {n for n in dir(builtins)
+                 if isinstance(getattr(builtins, n), type)
+                 and issubclass(getattr(builtins, n), BaseException)}
+# Stdlib exception classes commonly raised via module attribute.
+_STDLIB_EXTRA = {"TimeoutError", "CancelledError", "IncompleteReadError",
+                 "JSONDecodeError", "Empty", "Full"}
+
+
+def _is_handler(name: str) -> bool:
+    return (name.startswith("_h_") or name.startswith("_p_")
+            or name == "_on_request")
+
+
+class ExceptionTaxonomyPass(Pass):
+    """Swallowed bare/overbroad excepts + off-taxonomy handler raises."""
+
+    id = _ID
+
+    def wants(self, relpath: str) -> bool:
+        return relpath.startswith("ray_tpu/")
+
+    def check_file(self, ctx: FileCtx) -> tuple[list[Finding], Any]:
+        findings: list[Finding] = []
+        facts: dict[str, Any] = {}
+        if ctx.path in _TAXONOMY_FILES:
+            facts["taxonomy"] = sorted(_exception_classes(ctx.tree))
+        if "ray_tpu/_private/" in ctx.path:
+            findings.extend(_check_swallowed(ctx))
+        raises = _handler_raises(ctx)
+        if raises:
+            facts["raises"] = raises
+        return findings, facts or None
+
+    def finalize(self, facts: dict[str, Any], project) -> list[Finding]:
+        taxonomy = set(_BUILTIN_EXCS) | _STDLIB_EXTRA
+        have_tax = False
+        for fact in facts.values():
+            if fact.get("taxonomy"):
+                have_tax = True
+            taxonomy.update(fact.get("taxonomy", ()))
+        if not have_tax:
+            # Restricted-root run: the taxonomy modules weren't scanned —
+            # read them from disk rather than false-flagging every
+            # legitimate handler raise.
+            for relp in _TAXONOMY_FILES:
+                src = project.read_text(relp)
+                if src is not None:
+                    try:
+                        taxonomy |= _exception_classes(ast.parse(src))
+                    except SyntaxError:
+                        pass
+        findings = []
+        for path, fact in sorted(facts.items()):
+            for r in fact.get("raises", ()):
+                if r["exc"] not in taxonomy:
+                    findings.append(Finding(
+                        _ID, path, r["line"],
+                        f"RPC handler `{r['fn']}` raises {r['exc']}, which "
+                        f"is not in ray_tpu.exceptions / rpc transport "
+                        f"errors / stdlib builtins — peers re-surface "
+                        f"handler exceptions across the wire, so they must "
+                        f"come from the shared taxonomy"))
+        return findings
+
+
+def _exception_classes(tree: ast.AST) -> set[str]:
+    """Exception classes defined in (or imported into) a taxonomy module."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            out.add(node.name)
+        elif isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                out.add(alias.asname or alias.name)
+    return out
+
+
+def _check_swallowed(ctx: FileCtx) -> list[Finding]:
+    findings = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        is_bare = node.type is None
+        catches_base = (isinstance(node.type, (ast.Name, ast.Attribute))
+                        and terminal_name(node.type) == "BaseException")
+        if isinstance(node.type, ast.Tuple):
+            catches_base = any(terminal_name(e) == "BaseException"
+                               for e in node.type.elts)
+        if not is_bare and not catches_base:
+            continue
+        reraises = any(isinstance(n, ast.Raise) and n.exc is None
+                       for n in ast.walk(node))
+        uses_bound = node.name is not None and any(
+            isinstance(n, ast.Name) and n.id == node.name
+            for stmt in node.body for n in ast.walk(stmt))
+        if is_bare:
+            findings.append(Finding(
+                _ID, ctx.path, node.lineno,
+                "bare `except:` swallows KeyboardInterrupt/SystemExit — "
+                "on worker paths that breaks cancel/timeout SIGINT "
+                "delivery; catch `Exception` (or name the types)"))
+        elif not reraises and not uses_bound:
+            findings.append(Finding(
+                _ID, ctx.path, node.lineno,
+                "`except BaseException:` that neither re-raises nor uses "
+                "the exception swallows interpreter-exit signals; catch "
+                "`Exception` or handle what you caught"))
+    return findings
+
+
+def _handler_raises(ctx: FileCtx) -> list[dict]:
+    """All `raise X(...)` / `raise X` inside RPC handler functions."""
+    out = []
+
+    class V(ast.NodeVisitor):
+        def __init__(self):
+            self.stack: list[str] = []
+
+        def _fn(self, node):
+            self.stack.append(node.name)
+            self.generic_visit(node)
+            self.stack.pop()
+
+        visit_FunctionDef = _fn
+        visit_AsyncFunctionDef = _fn
+
+        def visit_Raise(self, node: ast.Raise):
+            if not any(_is_handler(f) for f in self.stack):
+                return
+            exc = node.exc
+            if exc is None:
+                return  # bare re-raise
+            if isinstance(exc, ast.Call):
+                exc = exc.func
+            name = terminal_name(exc)
+            # `raise e` of a caught/local variable: unresolvable statically;
+            # lowercase names are assumed to be variables, not classes.
+            if name is None or (name[:1].islower() and "Error" not in name):
+                return
+            if not ctx.suppressed(_ID, node.lineno):
+                out.append({"fn": self.stack[-1], "exc": name,
+                            "line": node.lineno})
+
+    V().visit(ctx.tree)
+    return out

@@ -1,0 +1,374 @@
+"""lock-discipline: acquisition order is acyclic; helper-thread classes
+don't mutate shared state half-locked.
+
+The runtime's deadlock surface is `threading.Lock`s shared between event
+loops, executor threads and helper threads (checkpoint writer, watchdog
+monitor, rpc flusher, metrics flusher, log flusher). Two invariant classes:
+
+1. **Acquisition order**: build a lock-order graph from lexical
+   `with <lock>:` nesting — plus one level of `self.method()` indirection
+   inside a held block (method A holds lock X and calls method B which
+   takes lock Y => edge X->Y). A cycle means two threads can deadlock by
+   acquiring in opposite orders. Locks are identified per class
+   (`ClassName._lock`) or per module for module-level locks; edges are
+   merged across files before cycle detection.
+
+2. **Half-locked attributes**: in classes that OWN a helper thread (they
+   construct `threading.Thread`/`Timer` somewhere), an attribute assigned
+   both inside a `with <lock>:` block and outside any lock (outside
+   `__init__`, which runs before the thread exists) is a data-race
+   candidate — the lock is decoration on one side. The same check runs at
+   module scope (`global`-declared writes vs module-level locks) for the
+   metrics-flusher / checkpoint-writer shape, which guards module globals
+   rather than instance attributes.
+
+Suppress individual sites with `# rtcheck: disable=lock-discipline` plus a
+comment saying why the unlocked write is safe (e.g. single-writer field,
+thread not yet started, monotonic flag).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Any, Optional
+
+from tools.rtcheck.astutil import dotted
+from tools.rtcheck.core import FileCtx, Finding, Pass
+
+_ID = "lock-discipline"
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+_THREAD_CTORS = {"Thread", "Timer"}
+
+
+class LockDisciplinePass(Pass):
+    """Lock-order cycles + half-locked attribute mutation."""
+
+    id = _ID
+
+    def wants(self, relpath: str) -> bool:
+        return relpath.startswith("ray_tpu/")
+
+    def check_file(self, ctx: FileCtx) -> tuple[list[Finding], Any]:
+        findings: list[Finding] = []
+        classes = []
+        for node in ctx.tree.body:
+            if isinstance(node, ast.ClassDef):
+                cls = _ClassAnalysis(ctx, node)
+                findings.extend(cls.check_half_locked())
+                if cls.edges or cls.lock_attrs:
+                    classes.append(cls.facts())
+        # Module scope: the checkpoint writer and metrics flusher guard
+        # module globals with module-level locks — same invariants, no class.
+        mod = _ModuleAnalysis(ctx)
+        findings.extend(mod.check_half_locked())
+        if mod.edges or mod.locks:
+            classes.append(mod.facts())
+        facts = {"classes": classes} if classes else None
+        return findings, facts
+
+    def finalize(self, facts: dict[str, Any], project) -> list[Finding]:
+        # Merge edges across files (a class reopened/subclassed elsewhere
+        # contributes to the same node set) and detect cycles.
+        findings: list[Finding] = []
+        graph: dict[str, set[str]] = {}
+        where: dict[tuple[str, str], tuple[str, int]] = {}
+        for path, fact in sorted(facts.items()):
+            for cls in fact.get("classes", ()):
+                for a, b, line in cls["edges"]:
+                    graph.setdefault(a, set()).add(b)
+                    where.setdefault((a, b), (path, line))
+        for cycle in _find_cycles(graph):
+            a, b = cycle[0], cycle[1 % len(cycle)]
+            path, line = where.get((a, b), ("ray_tpu", 1))
+            pretty = " -> ".join(cycle + [cycle[0]])
+            findings.append(Finding(
+                _ID, path, line,
+                f"lock acquisition cycle: {pretty} — two threads taking "
+                f"these in opposite orders deadlock"))
+        return findings
+
+
+def _find_cycles(graph: dict[str, set[str]]) -> list[list[str]]:
+    """Distinct elementary cycles (one representative per SCC is enough to
+    fail CI; the message names the members)."""
+    cycles: list[list[str]] = []
+    seen_cycles: set[frozenset] = set()
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in graph}
+    stack: list[str] = []
+
+    def dfs(n: str):
+        color[n] = GRAY
+        stack.append(n)
+        for m in sorted(graph.get(n, ())):
+            if color.get(m, WHITE) == GRAY:
+                cyc = stack[stack.index(m):]
+                key = frozenset(cyc)
+                if key not in seen_cycles:
+                    seen_cycles.add(key)
+                    cycles.append(list(cyc))
+            elif color.get(m, WHITE) == WHITE:
+                if m in color:
+                    dfs(m)
+        stack.pop()
+        color[n] = BLACK
+
+    for n in sorted(graph):
+        if color[n] == WHITE:
+            dfs(n)
+    return cycles
+
+
+class _ClassAnalysis:
+    def __init__(self, ctx: FileCtx, node: ast.ClassDef):
+        self.ctx = ctx
+        self.node = node
+        self.name = node.name
+        self.lock_attrs: set[str] = set()
+        self.owns_thread = False
+        #: method -> locks taken at its top level (not already held)
+        self.method_locks: dict[str, set[str]] = {}
+        #: (outer_lock, inner_lock, line) lexical nesting edges
+        self.edges: list[tuple[str, str, int]] = []
+        #: deferred (held_locks, callee, line) for one-level indirection
+        self._held_calls: list[tuple[tuple[str, ...], str, int]] = []
+        #: attr -> [(locked?, line, method)]
+        self.attr_writes: dict[str, list[tuple[bool, int, str]]] = {}
+        self._scan()
+
+    # ----------------------------------------------------------- collection
+    def _lock_name(self, expr: ast.AST) -> Optional[str]:
+        """Qualified lock id for a with-item context expr, or None."""
+        d = dotted(expr)
+        if d is None:
+            return None
+        if d.startswith("self."):
+            attr = d[5:]
+            if attr in self.lock_attrs or "lock" in attr.lower():
+                return f"{self.name}.{attr}"
+            return None
+        if "lock" in d.split(".")[-1].lower():
+            return f"{self.ctx.path}::{d}"  # module-level / foreign lock
+        return None
+
+    def _scan(self):
+        # Lock attrs can be created lazily outside __init__ (e.g. a log
+        # flusher initializing its lock on first use): collect from every
+        # method before classifying writes.
+        for item in self.node.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._collect_locks(item)
+        for item in self.node.body:
+            if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            self._scan_method(item)
+        # One level of call indirection: held lock + self.method() whose
+        # body takes more locks.
+        for held, callee, line in self._held_calls:
+            for inner in self.method_locks.get(callee, ()):
+                if inner not in held:
+                    self.edges.append((held[-1], inner, line))
+
+    def _collect_locks(self, fn: ast.FunctionDef):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                           ast.Call):
+                ctor = node.value.func
+                nm = ctor.attr if isinstance(ctor, ast.Attribute) else (
+                    ctor.id if isinstance(ctor, ast.Name) else None)
+                if nm in _LOCK_CTORS:
+                    for t in node.targets:
+                        d = dotted(t)
+                        if d and d.startswith("self."):
+                            self.lock_attrs.add(d[5:])
+
+    def _scan_method(self, fn: ast.FunctionDef):
+        method = fn.name
+        top_locks: set[str] = self.method_locks.setdefault(method, set())
+
+        def walk(node: ast.AST, held: tuple[str, ...]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda)):
+                    continue  # nested defs run elsewhere
+                if isinstance(child, (ast.With, ast.AsyncWith)):
+                    new_held = held
+                    for w in child.items:
+                        lock = self._lock_name(w.context_expr)
+                        if lock is not None:
+                            if not held:
+                                top_locks.add(lock)
+                            if new_held and lock != new_held[-1]:
+                                self.edges.append(
+                                    (new_held[-1], lock, child.lineno))
+                            if lock not in new_held:
+                                new_held = new_held + (lock,)
+                    walk(child, new_held)
+                    continue
+                if isinstance(child, ast.Call) and held:
+                    d = dotted(child.func)
+                    if d and d.startswith("self.") and "." not in d[5:]:
+                        self._held_calls.append((held, d[5:], child.lineno))
+                if isinstance(child, (ast.Assign, ast.AugAssign)):
+                    targets = (child.targets
+                               if isinstance(child, ast.Assign)
+                               else [child.target])
+                    for t in targets:
+                        d = dotted(t)
+                        if (d and d.startswith("self.")
+                                and "." not in d[5:]):
+                            attr = d[5:]
+                            if (attr not in self.lock_attrs
+                                    and not self.ctx.suppressed(
+                                        _ID, child.lineno)):
+                                self.attr_writes.setdefault(attr, []).append(
+                                    (bool(held), child.lineno, method))
+                walk(child, held)
+
+        # Thread ownership: any Thread(...) construction in any method.
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                nm = (node.func.attr
+                      if isinstance(node.func, ast.Attribute)
+                      else node.func.id
+                      if isinstance(node.func, ast.Name) else None)
+                if nm in _THREAD_CTORS:
+                    self.owns_thread = True
+        walk(fn, ())
+
+    # --------------------------------------------------------------- checks
+    def check_half_locked(self) -> list[Finding]:
+        if not self.owns_thread or not self.lock_attrs:
+            return []
+        findings = []
+        for attr, writes in sorted(self.attr_writes.items()):
+            locked = [w for w in writes if w[0]]
+            unlocked = [w for w in writes if not w[0]
+                        and w[2] not in ("__init__",)]
+            if locked and unlocked:
+                _ok, line, method = unlocked[0]
+                lmethods = sorted({w[2] for w in locked})
+                findings.append(Finding(
+                    _ID, self.ctx.path, line,
+                    f"{self.name}.{attr} is written under a lock in "
+                    f"{lmethods} but without one in `{method}` — this "
+                    f"class owns a helper thread, so the unlocked write "
+                    f"races (lock it, or suppress with a why-safe "
+                    f"comment)"))
+        return findings
+
+    def facts(self) -> dict:
+        return {"class": self.name,
+                "edges": [list(e) for e in self.edges],
+                "locks": sorted(self.lock_attrs)}
+
+
+class _ModuleAnalysis:
+    """Module-scope edition: module-level threading locks guarding module
+    globals mutated from helper threads (the metrics flusher / checkpoint
+    writer shape). A global written both under a module lock and outside
+    one — in a module that starts threads — is the same race as the class
+    case; `global`-declared assignment targets are the write set."""
+
+    def __init__(self, ctx: FileCtx):
+        self.ctx = ctx
+        self.locks: set[str] = set()
+        self.owns_thread = False
+        self.edges: list[tuple[str, str, int]] = []
+        #: global name -> [(locked?, line, fn)]
+        self.writes: dict[str, list[tuple[bool, int, str]]] = {}
+        self._scan()
+
+    def _lock_id(self, expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Name) and (expr.id in self.locks
+                                           or "lock" in expr.id.lower()):
+            return f"{self.ctx.path}::{expr.id}"
+        return None
+
+    def _scan(self):
+        for node in ast.walk(self.ctx.tree):
+            if isinstance(node, ast.Call):
+                nm = (node.func.attr if isinstance(node.func, ast.Attribute)
+                      else node.func.id
+                      if isinstance(node.func, ast.Name) else None)
+                if nm in _THREAD_CTORS:
+                    self.owns_thread = True
+        for node in self.ctx.tree.body:
+            if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                           ast.Call):
+                ctor = node.value.func
+                nm = ctor.attr if isinstance(ctor, ast.Attribute) else (
+                    ctor.id if isinstance(ctor, ast.Name) else None)
+                if nm in _LOCK_CTORS:
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            self.locks.add(t.id)
+        for node in self.ctx.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_fn(node)
+
+    def _scan_fn(self, fn):
+        globals_here: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Global):
+                globals_here.update(node.names)
+        if not globals_here and not self.locks:
+            return
+
+        def walk(node: ast.AST, held: tuple[str, ...]):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                      ast.Lambda, ast.ClassDef)):
+                    continue
+                if isinstance(child, (ast.With, ast.AsyncWith)):
+                    new_held = held
+                    for w in child.items:
+                        lock = self._lock_id(w.context_expr)
+                        if lock is not None:
+                            if new_held and lock != new_held[-1]:
+                                self.edges.append(
+                                    (new_held[-1], lock, child.lineno))
+                            if lock not in new_held:
+                                new_held = new_held + (lock,)
+                    walk(child, new_held)
+                    continue
+                if isinstance(child, (ast.Assign, ast.AugAssign)):
+                    targets = (child.targets
+                               if isinstance(child, ast.Assign)
+                               else [child.target])
+                    for t in targets:
+                        for el in (t.elts if isinstance(t, ast.Tuple)
+                                   else [t]):
+                            if (isinstance(el, ast.Name)
+                                    and el.id in globals_here
+                                    and el.id not in self.locks
+                                    and not self.ctx.suppressed(
+                                        _ID, child.lineno)):
+                                self.writes.setdefault(el.id, []).append(
+                                    (bool(held), child.lineno, fn.name))
+                walk(child, held)
+
+        walk(fn, ())
+
+    def check_half_locked(self) -> list[Finding]:
+        if not self.owns_thread or not self.locks:
+            return []
+        findings = []
+        for name, writes in sorted(self.writes.items()):
+            locked = [w for w in writes if w[0]]
+            unlocked = [w for w in writes if not w[0]]
+            if locked and unlocked:
+                _ok, line, fn = unlocked[0]
+                lfns = sorted({w[2] for w in locked})
+                findings.append(Finding(
+                    _ID, self.ctx.path, line,
+                    f"module global `{name}` is written under a lock in "
+                    f"{lfns} but without one in `{fn}` — this module "
+                    f"starts a helper thread, so the unlocked write races "
+                    f"(lock it, or suppress with a why-safe comment)"))
+        return findings
+
+    def facts(self) -> dict:
+        return {"class": f"{self.ctx.path}::<module>",
+                "edges": [list(e) for e in self.edges],
+                "locks": sorted(self.locks)}

@@ -1,0 +1,367 @@
+"""wire-schema: compact wire tuples must not drift between ends.
+
+The hot-path wire formats (`TaskSpec.__getstate__`, `task_call_tuple` for
+`exec_tasks` frames, `actor_call_tuple` for `actor_calls` frames, the
+`tasks_done` item) are positional tuples re-built by hand on the consumer
+side. Adding a field to one end without the other produced the PR 9 wire
+extension bug class; this pass makes the drift a CI failure.
+
+Two mechanisms:
+
+1. **Automatic `__getstate__`/`__setstate__` pairing** — for every class in
+   ray_tpu/ defining both: the encoder's tuple arity must equal the
+   decoder's unpack arity, every `if len(s) == K:` back-compat branch must
+   pad the tuple (a default for the missing field), and the supported
+   arities {K...} ∪ {final} must be contiguous — growing the tuple without
+   a branch for the previous arity breaks old snapshots/peers and is
+   flagged.
+
+2. **`# rtcheck: wire=<name>` markers** — encoders and decoders of one wire
+   record carry the same marker; the marker is only the cross-file join
+   key, arity is always computed from the AST at the marked site:
+   a tuple literal => producer arity; a tuple-unpack assignment (or
+   `for a, b, ... in`) => consumer arity; integer subscripts => a minimum
+   arity. All producers must agree, every consumer unpack must match, every
+   subscript must stay in range, and each wire needs at least one producer
+   AND one consumer (deleting half the markers is itself a finding). Marked
+   decoder functions get the same back-compat branch check as
+   `__setstate__`.
+
+Known wires are listed in REQUIRED_WIRES so wholesale marker deletion
+cannot silence the pass.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Any, Optional
+
+from tools.rtcheck.astutil import enclosing_function, statement_at
+from tools.rtcheck.core import FileCtx, Finding, Pass
+
+_MARKER_RE = re.compile(r"#\s*rtcheck:\s*wire=([\w.\-]+)")
+
+#: Wire names that MUST have marked producer+consumer sites somewhere under
+#: ray_tpu/ — the frame formats the runtime actually ships today. Enforced
+#: only when every file holding those markers was scanned this run (a
+#: file-scoped invocation must not report phantom marker deletion).
+REQUIRED_WIRES = ("exec_tasks.call", "actor_calls.call", "tasks_done.item")
+REQUIRED_WIRE_FILES = (
+    "ray_tpu/_private/task_spec.py",
+    "ray_tpu/_private/lease.py",
+    "ray_tpu/_private/worker.py",
+    "ray_tpu/_private/worker_proc.py",
+)
+
+_ID = "wire-schema"
+
+
+class WireSchemaPass(Pass):
+    """Check wire-tuple encoder/decoder arity agreement and back-compat."""
+
+    id = _ID
+
+    def wants(self, relpath: str) -> bool:
+        return relpath.startswith("ray_tpu/")
+
+    # ------------------------------------------------------------- per file
+    def check_file(self, ctx: FileCtx) -> tuple[list[Finding], Any]:
+        findings: list[Finding] = []
+        facts: dict[str, Any] = {"sites": [], "state_pairs": []}
+
+        for cls, enc, dec in _state_pairs(ctx.tree):
+            pair_findings, pair = _check_state_pair(ctx, cls, enc, dec)
+            findings.extend(pair_findings)
+            if pair is not None:
+                facts["state_pairs"].append(pair)
+
+        for lineno, wire in _markers(ctx):
+            site, err = _analyze_site(ctx, lineno, wire)
+            if err is not None:
+                findings.append(Finding(_ID, ctx.path, lineno, err))
+            if site is not None:
+                facts["sites"].append(site)
+                if site["kind"] == "consumer" and site.get("branches"):
+                    findings.extend(_check_branch_coverage(
+                        ctx, lineno, wire, site))
+
+        if not facts["sites"] and not facts["state_pairs"]:
+            facts = None
+        return findings, facts
+
+    # ------------------------------------------------------------- finalize
+    def finalize(self, facts: dict[str, Any], project) -> list[Finding]:
+        findings: list[Finding] = []
+        wires: dict[str, list[dict]] = {}
+        for path, fact in facts.items():
+            for site in fact.get("sites", ()):
+                site = dict(site, path=path)
+                wires.setdefault(site["wire"], []).append(site)
+
+        # Only meaningful when every marker-holding module was scanned this
+        # run — fixture repos have none of them, and a restricted-root run
+        # (`rtcheck ray_tpu/serve`, or a single-file invocation) must not
+        # report markers it never looked for.
+        full_scan = all(p in project.analyzed for p in REQUIRED_WIRE_FILES)
+        for wire in REQUIRED_WIRES if full_scan else ():
+            if wire not in wires:
+                findings.append(Finding(
+                    _ID, "ray_tpu/_private/task_spec.py", 1,
+                    f"required wire '{wire}' has no `# rtcheck: wire=` "
+                    f"marked sites — markers were removed without removing "
+                    f"the wire format"))
+
+        for wire, sites in sorted(wires.items()):
+            producers = [s for s in sites if s["kind"] == "producer"]
+            consumers = [s for s in sites if s["kind"] == "consumer"]
+            subscripts = [s for s in sites if s["kind"] == "subscript"]
+            if not producers:
+                s = sites[0]
+                findings.append(Finding(
+                    _ID, s["path"], s["line"],
+                    f"wire '{wire}' has consumers but no marked producer"))
+                continue
+            if not consumers and not subscripts:
+                s = producers[0]
+                findings.append(Finding(
+                    _ID, s["path"], s["line"],
+                    f"wire '{wire}' has producers but no marked consumer"))
+            arities = sorted({p["arity"] for p in producers})
+            if len(arities) > 1:
+                for p in producers:
+                    findings.append(Finding(
+                        _ID, p["path"], p["line"],
+                        f"wire '{wire}' producers disagree on arity "
+                        f"({arities}) — this site builds {p['arity']} "
+                        f"fields"))
+                continue
+            arity = arities[0]
+            for c in consumers:
+                if c["arity"] != arity:
+                    findings.append(Finding(
+                        _ID, c["path"], c["line"],
+                        f"wire '{wire}' decoder unpacks {c['arity']} fields "
+                        f"but the encoder builds {arity} — update the "
+                        f"decoder (and add a back-compat branch with a "
+                        f"default for old senders)"))
+            for s in subscripts:
+                if s["min_arity"] > arity:
+                    findings.append(Finding(
+                        _ID, s["path"], s["line"],
+                        f"wire '{wire}' consumer indexes field "
+                        f"{s['min_arity'] - 1} but the encoder builds only "
+                        f"{arity}"))
+        return findings
+
+
+# ------------------------------------------------------- state pair analysis
+def _state_pairs(tree: ast.AST):
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        enc = dec = None
+        for item in node.body:
+            if isinstance(item, ast.FunctionDef):
+                if item.name == "__getstate__":
+                    enc = item
+                elif item.name == "__setstate__":
+                    dec = item
+        if enc is not None and dec is not None:
+            yield node.name, enc, dec
+
+
+def _return_tuple_arity(fn: ast.FunctionDef) -> Optional[int]:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Return) and isinstance(node.value, ast.Tuple):
+            return len(node.value.elts)
+    return None
+
+
+def _unpack_arity(fn: ast.FunctionDef,
+                  var: Optional[str] = None) -> Optional[tuple[int, int]]:
+    """(arity, line) of the tuple-unpack assignment in fn — the one whose
+    RHS is `var` when given (so an unrelated unpack of some other tuple in
+    the same function can't masquerade as the wire decode), else the
+    widest."""
+    best: Optional[tuple[int, int]] = None
+    fallback: Optional[tuple[int, int]] = None
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if not isinstance(t, ast.Tuple):
+                    continue
+                cand = (len(t.elts), node.lineno)
+                if (var is not None and isinstance(node.value, ast.Name)
+                        and node.value.id == var):
+                    if best is None or cand[0] > best[0]:
+                        best = cand
+                if fallback is None or cand[0] > fallback[0]:
+                    fallback = cand
+    return best if best is not None else fallback
+
+
+def _len_branches(fn: ast.FunctionDef,
+                  var: Optional[str] = None) -> list[tuple[int, ast.If]]:
+    """[(K, if-node)] for every `if len(<var>) == K:` guard in fn. `var`
+    scopes the match to the wire-tuple variable — an unrelated
+    `if len(args) == 3:` in the same function must not register as a
+    back-compat branch (and then fail the contiguity check)."""
+    out = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.If):
+            continue
+        t = node.test
+        if (isinstance(t, ast.Compare) and len(t.ops) == 1
+                and isinstance(t.ops[0], ast.Eq)
+                and isinstance(t.left, ast.Call)
+                and isinstance(t.left.func, ast.Name)
+                and t.left.func.id == "len"
+                and len(t.left.args) == 1
+                and isinstance(t.left.args[0], ast.Name)
+                and (var is None or t.left.args[0].id == var)
+                and len(t.comparators) == 1
+                and isinstance(t.comparators[0], ast.Constant)
+                and isinstance(t.comparators[0].value, int)):
+            out.append((t.comparators[0].value, node))
+    return out
+
+
+def _branch_pads(branch: ast.If) -> bool:
+    """A back-compat branch must rebuild the tuple (pad with defaults)."""
+    for node in ast.walk(branch):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            return True
+    return False
+
+
+def _contiguity(ctx: FileCtx, line: int, label: str, final: int,
+                branch_ks: list[int]) -> list[Finding]:
+    """Supported arities must form K_min..final with no gap: a gap means the
+    tuple grew without a back-compat branch for the previous arity."""
+    findings = []
+    high = sorted(k for k in set(branch_ks) if k >= final)
+    if high:
+        # Branching on the CURRENT (or a larger) arity is the typo class
+        # where the dev branched on the new size instead of the old one.
+        findings.append(Finding(
+            _ID, ctx.path, line,
+            f"{label}: `len == {high[0]}` back-compat branch is not below "
+            f"the decoder's arity {final} — branch on the OLD arity"))
+    supported = sorted(set(k for k in branch_ks if k < final) | {final})
+    missing = sorted(set(range(supported[0], final + 1)) - set(supported))
+    if missing:
+        findings.append(Finding(
+            _ID, ctx.path, line,
+            f"{label}: back-compat gap — handles arities {supported} but "
+            f"not {missing}; arity growth must carry a `len(...) == "
+            f"{missing[0]}` branch appending a default"))
+    return findings
+
+
+def _check_state_pair(ctx: FileCtx, cls: str, enc: ast.FunctionDef,
+                      dec: ast.FunctionDef):
+    findings: list[Finding] = []
+    enc_arity = _return_tuple_arity(enc)
+    # The state tuple is __setstate__'s sole non-self parameter: scope both
+    # the unpack and the back-compat branches to IT.
+    state_var = (dec.args.args[1].arg if len(dec.args.args) > 1 else None)
+    unpack = _unpack_arity(dec, state_var)
+    if enc_arity is None or unpack is None:
+        return findings, None  # non-tuple state protocol; out of scope
+    dec_arity, dec_line = unpack
+    label = f"{cls}.__getstate__/__setstate__"
+    if enc_arity != dec_arity:
+        findings.append(Finding(
+            _ID, ctx.path, dec_line,
+            f"{label}: encoder builds {enc_arity} fields, decoder unpacks "
+            f"{dec_arity}"))
+    branch_ks = []
+    for k, branch in _len_branches(dec, state_var):
+        branch_ks.append(k)
+        if not _branch_pads(branch):
+            findings.append(Finding(
+                _ID, ctx.path, branch.lineno,
+                f"{label}: `len == {k}` back-compat branch does not pad "
+                f"the tuple with a default"))
+    if branch_ks:
+        findings.extend(
+            _contiguity(ctx, dec_line, label, dec_arity, branch_ks))
+    pair = {"class": cls, "enc": enc_arity, "dec": dec_arity,
+            "branches": sorted(branch_ks)}
+    return findings, pair
+
+
+# ------------------------------------------------------------- marker sites
+def _markers(ctx: FileCtx):
+    # Real comments only (ctx.comments is tokenizer-derived): a string
+    # literal documenting the marker syntax must not fabricate a wire site.
+    for i, ln in ctx.comments.items():
+        if "rtcheck:" not in ln:
+            continue
+        m = _MARKER_RE.search(ln)
+        if m:
+            yield i, m.group(1)
+
+
+def _analyze_site(ctx: FileCtx, line: int, wire: str):
+    """Classify the statement under a wire marker and compute its arity."""
+    stmt = statement_at(ctx.tree, line)
+    if stmt is None:
+        return None, f"wire '{wire}' marker is not attached to a statement"
+    # Producer: a tuple literal (the widest one in the statement) being
+    # returned / assigned / passed.
+    widest: Optional[ast.Tuple] = None
+    for node in ast.walk(stmt):
+        if isinstance(node, ast.Tuple) and not isinstance(
+                getattr(node, "ctx", None), ast.Store):
+            if widest is None or len(node.elts) > len(widest.elts):
+                widest = node
+    # Consumer: a tuple-unpack assignment or for-target.
+    unpack: Optional[int] = None
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            if isinstance(t, ast.Tuple):
+                unpack = len(t.elts)
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)) and isinstance(
+            stmt.target, ast.Tuple):
+        unpack = len(stmt.target.elts)
+    if unpack is not None:
+        # Scope back-compat branches to the variable actually being
+        # decoded at the marked site (the unpack's RHS / the iterated
+        # name). Unknown source (subscript, call) => collect NO branches:
+        # skipping the contiguity check beats registering some unrelated
+        # `len(...)` guard as a wire branch.
+        rec_var = None
+        if isinstance(stmt, ast.Assign) and isinstance(stmt.value, ast.Name):
+            rec_var = stmt.value.id
+        elif (isinstance(stmt, (ast.For, ast.AsyncFor))
+              and isinstance(stmt.iter, ast.Name)):
+            rec_var = stmt.iter.id
+        fn = enclosing_function(ctx.tree, line)
+        branches = (sorted(k for k, _ in _len_branches(fn, rec_var))
+                    if fn is not None and rec_var is not None else [])
+        return {"wire": wire, "line": line, "kind": "consumer",
+                "arity": unpack, "branches": branches}, None
+    if widest is not None and len(widest.elts) >= 2:
+        return {"wire": wire, "line": line, "kind": "producer",
+                "arity": len(widest.elts)}, None
+    # Subscript consumer: integer indexes into the record.
+    max_idx = -1
+    for node in ast.walk(stmt):
+        if (isinstance(node, ast.Subscript)
+                and isinstance(node.slice, ast.Constant)
+                and isinstance(node.slice.value, int)
+                and node.slice.value >= 0):
+            max_idx = max(max_idx, node.slice.value)
+    if max_idx >= 0:
+        return {"wire": wire, "line": line, "kind": "subscript",
+                "min_arity": max_idx + 1}, None
+    return None, (f"wire '{wire}' marker site is neither a tuple literal, "
+                  f"a tuple unpack, nor an integer subscript")
+
+
+def _check_branch_coverage(ctx: FileCtx, line: int, wire: str,
+                           site: dict) -> list[Finding]:
+    return _contiguity(ctx, line, f"wire '{wire}' decoder", site["arity"],
+                       site["branches"])
