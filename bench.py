@@ -237,6 +237,10 @@ def main(smoke: bool = False):
         # Checkpoint engine: raw save throughput + async-overlap A/B
         # (train-loop step time with async checkpointing vs none vs sync).
         _bench_checkpoint(extra_details)
+        # Tracing plane A/B (perf-gate input): single-client async task
+        # batches with RT_TRACING unset vs sampled-on — the off path must
+        # be free, the sampled-on path must stay under 5% overhead.
+        _bench_tracing_overhead(extra_details)
 
     ratios = {k: results[k] / BASELINES[k] for k in BASELINES if k in results}
     # put-GB/s is bounded by this host's memcpy bandwidth (one mandatory
@@ -389,6 +393,67 @@ def _bench_device_object_p2p(details: dict):
         f"{host:.2f} GB/s ({dev / max(host, 1e-9):.2f}x)")
     details["device_object_p2p_gbps"] = round(dev, 2)
     details["device_object_p2p_host_gbps"] = round(host, 2)
+
+
+def _bench_tracing_overhead(details: dict):
+    """Tracing-plane A/B (smoke only; README "Tracing & timeline"): the
+    single_client_tasks_async workload on a fresh cluster with RT_TRACING
+    unset vs sampled-on (RT_TRACING=1, RT_TRACE_SAMPLE=0.01 — the
+    production head-sampling shape). The perf gate
+    (tests/test_perf_smoke.py, RT_RUN_PERF=1) asserts the off path sits
+    within noise of the main run's rate (tracing compiled in but disarmed
+    costs nothing) and sampled-on costs < 1.05x."""
+    import ray_tpu
+
+    def run_once(tracing_on: bool) -> float:
+        prev_t = os.environ.pop("RT_TRACING", None)
+        prev_s = os.environ.pop("RT_TRACE_SAMPLE", None)
+        if tracing_on:
+            os.environ["RT_TRACING"] = "1"
+            os.environ["RT_TRACE_SAMPLE"] = "0.01"
+        try:
+            ray_tpu.init(num_cpus=4)
+
+            @ray_tpu.remote
+            def noop():
+                return None
+
+            ray_tpu.get([noop.remote() for _ in range(8)], timeout=120)
+            return timeit(
+                f"single client tasks async "
+                f"(tracing {'sampled-on' if tracing_on else 'off'})",
+                lambda: ray_tpu.get([noop.remote() for _ in range(100)],
+                                    timeout=120),
+                multiplier=100, min_time=max(MIN_TIME, 1.0))
+        finally:
+            for k, v in (("RT_TRACING", prev_t), ("RT_TRACE_SAMPLE", prev_s)):
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            try:
+                ray_tpu.shutdown()
+            except Exception:
+                pass
+
+    try:
+        # Interleaved best-of-3 per leg: on loaded/shared CI boxes single
+        # windows swing far past the 5% budget this lane gates (observed
+        # 0.79x-2.9x for the SAME build back to back); alternating legs
+        # and keeping each side's best quiet window measures the plane,
+        # not the ambient scheduler.
+        off = on = 0.0
+        for _ in range(3):
+            off = max(off, run_once(tracing_on=False))
+            on = max(on, run_once(tracing_on=True))
+    except Exception as e:
+        log(f"  tracing_overhead skipped: {e}")
+        return
+    log(f"  tracing_overhead: off {off:,.0f}/s vs sampled-on {on:,.0f}/s "
+        f"({off / max(on, 1e-9):.3f}x, best of 3 each)")
+    details["tracing_off_tasks_s"] = round(off, 1)
+    details["tracing_on_tasks_s"] = round(on, 1)
+    details["tracing_overhead"] = round(off / max(on, 1e-9), 3)
 
 
 # ---- compiled-graph channel round-trip (native futex ring) ---------------

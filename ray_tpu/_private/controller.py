@@ -132,6 +132,24 @@ class Controller:
         self.jobs: dict[str, dict] = {}
         # (metric name, sorted tag tuple) -> aggregated series
         self.metrics: dict[tuple, dict] = {}
+        # Histogram bucket boundaries, registered ONCE per name by
+        # `histogram_decl` records (observe records carry values only —
+        # shipping the boundary list per observation bloated every flush
+        # batch once the tracing plane added hot-path histograms).
+        self._hist_bounds: dict[str, list] = {}
+        # Tracing plane (README "Tracing & timeline"): trace_id -> {spans,
+        # start, last, name, root_done, dirty} in arrival order, bounded by
+        # RT_TRACE_MAX_TRACES (oldest evicted, persisted first). Served by
+        # list_traces/get_trace, `ray-tpu timeline`, /api/traces.
+        self.traces: dict[str, dict] = {}
+        self._trace_sweep_task: Optional[asyncio.Task] = None
+        # Evicted-but-unpersisted traces awaiting the persistence sweep.
+        # BOUNDED: under full-sampling overload (every task its own trace)
+        # evictions arrive at task rate, and persisting each inline was
+        # measured at ~3x task-throughput collapse on a 1-core box — the
+        # sweep drains a bounded batch per tick and sheds the rest (ring
+        # discipline, same as the flight recorder).
+        self._evicted_traces: deque = deque(maxlen=256)
         # task_id -> (force, expiry), for cancels that land while the task is
         # queued or mid-dispatch (neither pending nor dispatched yet).
         # Entries expire so cancels racing completion (or actor-method refs
@@ -1726,8 +1744,27 @@ class Controller:
     async def _p_metrics_report(self, conn, a):
         """Aggregate application metric records (reference: workers export
         through the metrics agent to Prometheus; here the controller is the
-        aggregation point, stats/metric.h role)."""
+        aggregation point, stats/metric.h role). Tracing spans piggyback on
+        the same frames (`spans` key) — see _ingest_spans."""
         for rec in a["records"]:
+            kind = rec["kind"]
+            if kind == "histogram_decl":
+                # Boundaries registered once per (name, boundaries) by the
+                # first observe in each process; value records then ride
+                # bare. Idempotent: duplicate decls (per-process, races)
+                # simply rewrite the same list.
+                self._hist_bounds[rec["name"]] = list(rec["boundaries"])
+                # Self-heal series that aggregated DEGRADED (one +Inf
+                # bucket) before their decl arrived — e.g. a decl lost to a
+                # dropped batch, re-sent after the worker reconnected. Past
+                # observations keep count/sum; bucketing starts now.
+                for ent in self.metrics.values():
+                    if (ent["name"] == rec["name"]
+                            and ent.get("buckets") is not None
+                            and not ent.get("boundaries")):
+                        ent["boundaries"] = list(rec["boundaries"])
+                        ent["buckets"] = [0] * (len(rec["boundaries"]) + 1)
+                continue
             key = (rec["name"], tuple(sorted(rec["tags"].items())))
             ent = self.metrics.get(key)
             if ent is None:
@@ -1736,23 +1773,194 @@ class Controller:
                     "desc": rec.get("desc", ""), "tags": rec["tags"],
                     "value": 0.0, "count": 0, "sum": 0.0, "buckets": None,
                 }
-            kind = rec["kind"]
             if kind == "counter":
                 ent["value"] += rec["value"]
             elif kind == "gauge":
                 ent["value"] = rec["value"]
             elif kind == "histogram":
                 if ent["buckets"] is None:
-                    ent["boundaries"] = rec["boundaries"]
-                    ent["buckets"] = [0] * (len(rec["boundaries"]) + 1)
+                    # Boundaries from the decl registry; legacy records
+                    # carrying them inline still work. A decl lost to a
+                    # controller restart degrades to count/sum only (one
+                    # +Inf bucket) instead of dropping observations.
+                    bounds = (rec.get("boundaries")
+                              or self._hist_bounds.get(rec["name"]) or [])
+                    ent["boundaries"] = list(bounds)
+                    ent["buckets"] = [0] * (len(bounds) + 1)
                 import bisect
 
                 ent["buckets"][bisect.bisect_left(ent["boundaries"], rec["value"])] += 1
                 ent["count"] += 1
                 ent["sum"] += rec["value"]
+        spans = a.get("spans")
+        if spans:
+            self._ingest_spans(spans)
 
     async def _h_get_metrics(self, conn, a):
         return {"metrics": list(self.metrics.values())}
+
+    # ------------------------------------------------------- tracing plane
+    _TRACE_SPAN_CAP = 8192  # spans kept per trace (ring discipline)
+
+    def _ingest_spans(self, spans: list) -> None:
+        """Index worker-drained spans per trace_id (README "Tracing &
+        timeline"). The index is a bounded arrival-order ring: past
+        RT_TRACE_MAX_TRACES the oldest trace is evicted (persisted first if
+        it never was). A span with no parent is the trace ROOT — its
+        arrival marks the trace complete."""
+        cap = max(1, int(CONFIG.trace_max_traces))
+        now = time.time()
+        for sp in spans:
+            tid = sp.get("t")
+            if not tid:
+                continue
+            ent = self.traces.get(tid)
+            if ent is None:
+                while len(self.traces) >= cap:
+                    old_tid = next(iter(self.traces))
+                    old = self.traces.pop(old_tid)
+                    if old.get("dirty"):
+                        self._evicted_traces.append((old_tid, old))
+                ent = self.traces[tid] = {
+                    "spans": [], "start": sp.get("a", now), "last": 0.0,
+                    "name": None, "root_done": False, "dirty": False,
+                    "recv": now,
+                }
+            if len(ent["spans"]) < self._TRACE_SPAN_CAP:
+                ent["spans"].append(sp)
+            ent["start"] = min(ent["start"], sp.get("a", now))
+            ent["last"] = max(ent["last"], sp.get("b", now))
+            ent["dirty"] = True
+            ent["recv"] = now
+            if sp.get("p") is None:
+                ent["root_done"] = True
+                ent["name"] = sp.get("n")
+            elif ent["name"] is None:
+                ent["name"] = sp.get("n")
+        if self._trace_sweep_task is None and not self._stopping:
+            self._trace_sweep_task = asyncio.ensure_future(
+                self._trace_sweep())
+            self._tasks.append(self._trace_sweep_task)
+
+    def _trace_dir(self) -> str | None:
+        d = CONFIG.trace_dir
+        if d == "none":
+            return None
+        if d:
+            return d
+        return os.path.join(CONFIG.session_dir, self.session_id, "traces")
+
+    async def _trace_sweep(self):
+        """Persist settled traces through the storage plane (PR 8), batched
+        and OFF the event loop: every ~2s, traces quiet for 2s with new
+        spans since their last write — plus a bounded batch of evicted
+        traces — go out as one executor job. Settled re-dirtied traces (a
+        late straggler span) re-persist next sweep."""
+        while not self._stopping:
+            await asyncio.sleep(2.0)
+            try:
+                d = self._trace_dir()
+                if d is None:
+                    self._evicted_traces.clear()
+                    continue
+                now = time.time()
+                batch = []
+                while self._evicted_traces and len(batch) < 128:
+                    tid, ent = self._evicted_traces.popleft()
+                    batch.append((tid, self._trace_doc(tid, ent)))
+                for tid, ent in self.traces.items():
+                    if ent["dirty"] and now - ent["recv"] >= 2.0:
+                        ent["dirty"] = False
+                        batch.append((tid, self._trace_doc(tid, ent)))
+                if batch:
+                    loop = asyncio.get_running_loop()
+                    await loop.run_in_executor(
+                        None, self._persist_traces_sync, d, batch)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # One bad tick (an executor mid-shutdown, a storage blip)
+                # must not end persistence for the controller's lifetime —
+                # the sweep-task sentinel is never reset, so a dead sweep
+                # would silently stop all trace persistence.
+                logger.exception("trace persistence sweep tick failed; "
+                                 "retrying")
+
+    @staticmethod
+    def _trace_doc(tid: str, ent: dict) -> dict:
+        return {"trace_id": tid, "name": ent.get("name"),
+                "start": ent.get("start"), "end": ent.get("last"),
+                "complete": bool(ent.get("root_done")),
+                "spans": list(ent["spans"])}
+
+    @staticmethod
+    def _persist_traces_sync(trace_dir: str, batch: list) -> None:
+        import json
+
+        from ray_tpu import storage
+
+        for tid, doc in batch:
+            try:
+                storage.put(storage.join(trace_dir, f"{tid}.json"),
+                            json.dumps(doc).encode())
+            except Exception:
+                logger.debug("trace persist failed for %s", tid,
+                             exc_info=True)
+
+    async def _h_list_traces(self, conn, a):
+        limit = int(a.get("limit", 1000))
+        rows = []
+        for tid, ent in self.traces.items():
+            rows.append({"trace_id": tid, "name": ent.get("name"),
+                         "start": ent.get("start"), "end": ent.get("last"),
+                         "spans": len(ent["spans"]),
+                         "complete": bool(ent.get("root_done"))})
+        return {"traces": rows[-limit:]}
+
+    async def _h_get_trace(self, conn, a):
+        """Spans of one trace; unique id prefixes accepted (CLI ergonomics).
+        Falls back to the storage plane for traces evicted from the ring."""
+        tid = a["trace_id"]
+        ent = self.traces.get(tid)
+        if ent is None:
+            matches = [t for t in self.traces if t.startswith(tid)]
+            if len(matches) == 1:
+                tid, ent = matches[0], self.traces[matches[0]]
+        if ent is not None:
+            return {"found": True, **self._trace_doc(tid, ent)}
+        d = self._trace_dir()
+        if d is not None:
+            loop = asyncio.get_running_loop()
+            doc = await loop.run_in_executor(
+                None, self._load_trace_sync, d, tid)
+            if doc is not None:
+                return {"found": True, **doc}
+        return {"found": False, "trace_id": tid, "spans": []}
+
+    @staticmethod
+    def _load_trace_sync(trace_dir: str, tid: str):
+        import json
+
+        from ray_tpu import storage
+
+        try:
+            return json.loads(
+                storage.get_bytes(storage.join(trace_dir, f"{tid}.json")))
+        except Exception:
+            pass
+        # Unique-PREFIX lookup over persisted ids: `ray-tpu stalls` prints
+        # 12-char trace prefixes, and an evicted trace only exists as its
+        # full-id file — the exact-name miss above must not make the
+        # suggested `ray-tpu timeline --trace <prefix>` a dead end.
+        try:
+            names = [n for n in storage.listdir(trace_dir)
+                     if n.endswith(".json") and n.startswith(tid)]
+            if len(names) == 1:
+                return json.loads(
+                    storage.get_bytes(storage.join(trace_dir, names[0])))
+        except Exception:
+            pass
+        return None
 
     async def _p_task_events(self, conn, a):
         self.task_events.extend(a["events"])

@@ -43,6 +43,7 @@ import threading
 import time
 
 from ray_tpu import exceptions as exc
+from ray_tpu._private import tracing as _tracing
 from ray_tpu._private.rtconfig import CONFIG
 
 logger = logging.getLogger(__name__)
@@ -380,7 +381,16 @@ def _resolve(desc: dict):
             f"runtime in this process (producer {desc['worker'][:12]})")
     mv = w.store.get(oid)  # a prior resolve / sibling export already local?
     if mv is None:
-        mv = _localize(w, desc)
+        # Tiers 1/2 do real network work (producer export RPC + attach or
+        # chunked fetch): span it so a traced consumer's timeline shows
+        # where device-object localization time goes. Tier 0 above stays
+        # span-free — a zero-copy dict hit must not pay tracing overhead.
+        same_host = tuple(desc["addr"])[0] == w.server_addr[0]
+        with _tracing.span("device.resolve", "device",
+                           {"oid": oid[:16], "nbytes": desc.get("nbytes"),
+                            "tier": "same_host" if same_host
+                            else "cross_host"}):
+            mv = _localize(w, desc)
     return w._deserialize_blob(mv)
 
 

@@ -25,6 +25,7 @@ from collections import deque
 from typing import Optional
 
 from ray_tpu._private import rpc
+from ray_tpu._private import tracing as _tracing
 from ray_tpu._private.rtconfig import CONFIG
 from ray_tpu._private.serialization import dumps_oob
 from ray_tpu._private.task_spec import STREAMING, TaskSpec
@@ -347,6 +348,12 @@ class LeaseManager:
                     common=(self.w.worker_id, self.w.server_addr,
                             lease.cls.resources),
                     calls=[s.task_call_tuple() for s in batch])
+                for s in batch:
+                    if s.trace is not None:
+                        _tracing.record_instant(
+                            s.trace, "dispatch", "dispatch",
+                            {"task": s.task_id,
+                             "worker": lease.worker_id[:12]})
             except Exception:
                 lease.flushing = False
                 self._lease_failed(lease)
@@ -383,6 +390,9 @@ class LeaseManager:
             with self._lock:
                 lease.cls.queue.appendleft(spec)
             return
+        if spec.trace is not None:
+            _tracing.record_instant(spec.trace, "result", "result",
+                                    {"task": tid, "ok": error is None})
         for oid, inline, size, holder in results or ():
             res = self.w._resolutions.get(oid)
             if res is not None:

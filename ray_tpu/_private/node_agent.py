@@ -426,6 +426,10 @@ class NodeAgent:
             reason = (f"stalled: task {report.get('name')!r} made no "
                       f"progress for {report.get('silence_s')}s "
                       f"(watchdog kill escalation)")
+            if report.get("trace_id"):
+                # Traced task: name the trace so the failure message links
+                # straight to `ray-tpu timeline --trace <id>`.
+                reason += f" [trace {str(report['trace_id'])[:16]}]"
             logger.warning("stall watchdog: killing worker %s — %s",
                            wid[:8], reason)
             # Report BEFORE terminating (the OOM-kill pattern) so owners
@@ -892,6 +896,24 @@ class NodeAgent:
             slot.proc.terminate()
         except Exception:
             pass
+        # SIGTERM escalation: a worker wedged in native code (or whose main
+        # thread can't reach the signal handler) survives terminate() — the
+        # kill must not depend on the victim's cooperation (the reference
+        # worker killer ends with SIGKILL for the same reason). The
+        # callback also poll()s, so the child is reaped even if the reap
+        # loop is momentarily behind.
+        def _escalate(proc=slot.proc):
+            try:
+                if proc.poll() is None:
+                    proc.kill()
+                    proc.poll()
+            except Exception:
+                pass
+
+        try:
+            asyncio.get_running_loop().call_later(2.0, _escalate)
+        except RuntimeError:
+            _escalate()  # no loop (teardown path): escalate immediately
 
     async def _reap_loop(self):
         """Detect worker process exits (reference: raylet learns via socket
@@ -900,90 +922,112 @@ class NodeAgent:
         idle_worker_killing_time_threshold_ms), keeping one warm."""
         while True:
             await asyncio.sleep(0.2)
-            for wid, slot in list(self.workers.items()):
-                if slot.proc.poll() is not None and slot.state != "dead":
-                    await self._worker_exited(slot, f"exit code {slot.proc.returncode}")
-            if self._direct_tasks:
-                now = time.monotonic()
-                for tid, rec in list(self._direct_tasks.items()):
-                    if rec.get("state") == "done" and rec["expires"] < now:
-                        self._direct_tasks.pop(tid, None)
-            # Stall backstop: a worker whose beacons STOPPED mid-task is too
-            # wedged to run its own monitor thread (native code holding the
-            # GIL) — its self-reported kill stage will never arrive, so the
-            # agent synthesizes it once the beacon goes stale past the kill
-            # threshold.
-            kill_s = CONFIG.stall_kill_s
-            if kill_s and kill_s > 0:
-                interval = max(0.05, CONFIG.stall_beacon_interval_s)
-                now = time.monotonic()
-                for slot in list(self.workers.values()):
-                    # Beacons flow every tick from ANY armed worker, task or
-                    # no task — so the trigger is the beacon STREAM going
-                    # stale, not the task it names (a task that wedges in
-                    # native code before its first named beacon leaves
-                    # beacon_task None forever; the worker is just as dead).
-                    # beacon_at == 0 means the worker never armed a
-                    # watchdog (old build / just spawned): nothing to judge.
-                    if (not slot.beacon_at
-                            or slot.state in ("dead", "starting")
-                            or slot.proc.poll() is not None):
-                        continue
-                    stale = now - slot.beacon_at
-                    if stale <= kill_s + 5 * interval:
-                        continue
-                    report = {
-                        "scope": "task", "stage": "kill", "backstop": True,
-                        "task_id": slot.beacon_task or slot.task_id,
-                        "name": None, "attempt": None, "kind": None,
-                        "worker_id": slot.worker_id,
-                        "node_id": self.node_id, "pid": slot.proc.pid,
-                        "silence_s": round(slot.beacon_silence + stale, 3),
-                        "time": time.time(),
-                        "reason": (f"progress beacons stopped for "
-                                   f"{stale:.1f}s (watchdog starved — "
-                                   f"worker wedged in native code?)"),
-                        "events": [], "flight_dir": None,
-                    }
-                    slot.beacon_at = 0.0  # escalate once
-                    slot.beacon_task = None
-                    await self._handle_stall_report(report)
-            keep = CONFIG.idle_worker_keep_s
-            if keep > 0:
-                # Workers still pinning device objects are the storage for
-                # those objects — exempt from the idle reap until the
-                # owner-tracked frees drain their table.
-                idle = [s for s in self.workers.values()
-                        if s.state == "idle" and not s.dedicated
-                        and not s.device_pinned]
-                now = time.monotonic()
-                warm = 1 if CONFIG.prestart_workers else 0
-                for slot in sorted(idle, key=lambda s: s.idle_since)[: max(0, len(idle) - warm)]:
-                    if now - slot.idle_since > keep:
-                        # Kill FIRST (atomic with the idle check — no await
-                        # between them, so a lease/dispatch cannot claim the
-                        # slot mid-reap), then report. The kill path skips
-                        # the worker_died report (_worker_exited sees
-                        # state=="dead"), but a pin could have landed since
-                        # the last device_pins report: tell the controller
-                        # so any device entries it produced go cleanly LOST
-                        # instead of pointing at a dead address forever.
-                        # Plane off => no pins possible, reap stays silent.
-                        self._kill_slot(slot)
-                        if CONFIG.device_objects:
-                            try:
-                                await self.controller.push(
-                                    "worker_died", worker_id=slot.worker_id,
-                                    task_id=None, actor_id=None,
-                                    reason="idle worker reaped", cause=None,
-                                    node_id=self.node_id,
-                                    incarnation=self.incarnation)
-                            except Exception:
-                                pass
+            try:
+                await self._reap_tick()
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # ONE bad tick (a report push racing a reconnecting
+                # controller conn, a stall-report failure) must not fell
+                # the loop for the agent's lifetime: with it dead, worker
+                # exits go undetected and killed workers linger as
+                # unreaped zombies whose pids stay probe-alive.
+                logger.exception("agent reap tick failed; retrying")
+
+    async def _reap_tick(self):
+        for wid, slot in list(self.workers.items()):
+            if slot.proc.poll() is not None and slot.state != "dead":
+                await self._worker_exited(slot, f"exit code {slot.proc.returncode}")
+        if self._direct_tasks:
+            now = time.monotonic()
+            for tid, rec in list(self._direct_tasks.items()):
+                if rec.get("state") == "done" and rec["expires"] < now:
+                    self._direct_tasks.pop(tid, None)
+        # Stall backstop: a worker whose beacons STOPPED mid-task is too
+        # wedged to run its own monitor thread (native code holding the
+        # GIL) — its self-reported kill stage will never arrive, so the
+        # agent synthesizes it once the beacon goes stale past the kill
+        # threshold.
+        kill_s = CONFIG.stall_kill_s
+        if kill_s and kill_s > 0:
+            interval = max(0.05, CONFIG.stall_beacon_interval_s)
+            now = time.monotonic()
+            for slot in list(self.workers.values()):
+                # Beacons flow every tick from ANY armed worker, task or
+                # no task — so the trigger is the beacon STREAM going
+                # stale, not the task it names (a task that wedges in
+                # native code before its first named beacon leaves
+                # beacon_task None forever; the worker is just as dead).
+                # beacon_at == 0 means the worker never armed a
+                # watchdog (old build / just spawned): nothing to judge.
+                if (not slot.beacon_at
+                        or slot.state in ("dead", "starting")
+                        or slot.proc.poll() is not None):
+                    continue
+                stale = now - slot.beacon_at
+                if stale <= kill_s + 5 * interval:
+                    continue
+                report = {
+                    "scope": "task", "stage": "kill", "backstop": True,
+                    "task_id": slot.beacon_task or slot.task_id,
+                    "name": None, "attempt": None, "kind": None,
+                    "worker_id": slot.worker_id,
+                    "node_id": self.node_id, "pid": slot.proc.pid,
+                    "silence_s": round(slot.beacon_silence + stale, 3),
+                    "time": time.time(),
+                    "reason": (f"progress beacons stopped for "
+                               f"{stale:.1f}s (watchdog starved — "
+                               f"worker wedged in native code?)"),
+                    "events": [], "flight_dir": None,
+                }
+                slot.beacon_at = 0.0  # escalate once
+                slot.beacon_task = None
+                await self._handle_stall_report(report)
+        keep = CONFIG.idle_worker_keep_s
+        if keep > 0:
+            # Workers still pinning device objects are the storage for
+            # those objects — exempt from the idle reap until the
+            # owner-tracked frees drain their table.
+            idle = [s for s in self.workers.values()
+                    if s.state == "idle" and not s.dedicated
+                    and not s.device_pinned]
+            now = time.monotonic()
+            warm = 1 if CONFIG.prestart_workers else 0
+            for slot in sorted(idle, key=lambda s: s.idle_since)[: max(0, len(idle) - warm)]:
+                if now - slot.idle_since > keep:
+                    # Kill FIRST (atomic with the idle check — no await
+                    # between them, so a lease/dispatch cannot claim the
+                    # slot mid-reap), then report. The kill path skips
+                    # the worker_died report (_worker_exited sees
+                    # state=="dead"), but a pin could have landed since
+                    # the last device_pins report: tell the controller
+                    # so any device entries it produced go cleanly LOST
+                    # instead of pointing at a dead address forever.
+                    # Plane off => no pins possible, reap stays silent.
+                    self._kill_slot(slot)
+                    if CONFIG.device_objects:
+                        try:
+                            await self.controller.push(
+                                "worker_died", worker_id=slot.worker_id,
+                                task_id=None, actor_id=None,
+                                reason="idle worker reaped", cause=None,
+                                node_id=self.node_id,
+                                incarnation=self.incarnation)
+                        except Exception:
+                            pass
 
     async def _worker_exited(self, slot: _WorkerSlot, reason: str,
                              cause: str | None = None):
         if slot.state == "dead":
+            # Reap the child BEFORE dropping the slot: this pop removes the
+            # Popen from the reap loop's poll() sweep, and an unreaped
+            # kill()ed worker lingers as a zombie whose pid still probes
+            # alive (observed as a rare chaos-test flake — the zombie's
+            # reaping then depended on GC/_cleanup luck). poll() here wins
+            # almost always (the conn close that routes us here fires at
+            # process exit); _kill_slot's escalation callback backstops the
+            # not-yet-exited case.
+            slot.proc.poll()
             self.workers.pop(slot.worker_id, None)
             self._purge_direct_tasks(slot.worker_id)
             return

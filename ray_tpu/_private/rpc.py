@@ -16,6 +16,7 @@ import asyncio
 import socket
 import struct
 import threading
+import time
 import traceback
 import weakref
 from typing import Awaitable, Callable, Optional
@@ -230,6 +231,22 @@ def set_flight_hook(fn) -> None:
     _FLIGHT = fn
 
 
+# ------------------------------------------------------------- trace hook
+# Frame-level hook for the distributed tracing plane (see
+# _private/tracing.py): fires ("rpc_send"/"rpc_recv", method) per frame and
+# ("rpc_call", method, rtt_seconds) per completed request round trip. None
+# (the default — RT_TRACING unset) keeps the hot path at exactly one
+# module-global check per frame, the same zero-cost-when-off pattern as
+# _INJECTOR and _FLIGHT. The hook itself discards events outside a sampled
+# trace context, so an armed-but-unsampled frame costs one contextvar read.
+_TRACE = None
+
+
+def set_trace_hook(fn) -> None:
+    global _TRACE
+    _TRACE = fn
+
+
 async def _hang_forever():
     """Park this coroutine permanently (injected 'hang': the frame — and the
     FIFO stream behind it — never moves, but the socket stays open)."""
@@ -351,6 +368,8 @@ class Connection:
         repeat, delay = 1, 0.0
         if _FLIGHT is not None:
             _FLIGHT("rpc_send", msg.get("m") or msg["k"])
+        if _TRACE is not None:
+            _TRACE("rpc_send", msg.get("m") or msg["k"])
         if _INJECTOR is not None:
             rule = _INJECTOR.pick(self, "send", msg)
             if rule is not None:
@@ -492,6 +511,8 @@ class Connection:
         rid = self._next_id
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         self._pending[rid] = fut
+        tr = _TRACE
+        t0 = time.monotonic() if tr is not None else 0.0
         try:
             await self._write({"k": "req", "id": rid, "m": method, "a": payload})
             if self.closed and not fut.done():
@@ -503,6 +524,8 @@ class Connection:
             return await fut
         finally:
             self._pending.pop(rid, None)
+            if tr is not None:
+                tr("rpc_call", method, time.monotonic() - t0)
 
     async def call_start(self, method: str, **payload) -> asyncio.Future:
         """Write a request and return the reply future WITHOUT awaiting it.
@@ -583,6 +606,8 @@ class Connection:
                 msg = await _read_msg(self.reader)
                 if _FLIGHT is not None:
                     _FLIGHT("rpc_recv", msg.get("m") or msg["k"])
+                if _TRACE is not None:
+                    _TRACE("rpc_recv", msg.get("m") or msg["k"])
                 if _INJECTOR is not None:
                     rule = _INJECTOR.pick(self, "recv", msg)
                     if rule is not None:
@@ -834,6 +859,8 @@ class LocalConnection:
             return  # wedged behind a held frame; link still "healthy"
         if _FLIGHT is not None:
             _FLIGHT("rpc_send", method)
+        if _TRACE is not None:
+            _TRACE("rpc_send", method)
         if _INJECTOR is not None:
             # The in-process transport has no frames; model the message
             # itself as one (send direction only — there is no reader side).
@@ -866,10 +893,16 @@ class LocalConnection:
 
     async def call(self, method: str, _timeout: float | None = None, **payload):
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        tr = _TRACE
+        t0 = time.monotonic() if tr is not None else 0.0
         self._deliver("req", method, payload, (asyncio.get_running_loop(), fut))
-        if _timeout is not None:
-            return await asyncio.wait_for(fut, _timeout)
-        return await fut
+        try:
+            if _timeout is not None:
+                return await asyncio.wait_for(fut, _timeout)
+            return await fut
+        finally:
+            if tr is not None:
+                tr("rpc_call", method, time.monotonic() - t0)
 
     async def call_start(self, method: str, **payload) -> asyncio.Future:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()

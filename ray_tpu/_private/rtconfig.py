@@ -180,6 +180,31 @@ _flag("stall_flight_dir", str, "")
 # the op, group, and the peer it was waiting on. <=0 falls back to the
 # module default (120s) — a wedged ring never hangs forever either way.
 _flag("collective_timeout_s", float, 0.0)
+# --- distributed tracing (README "Tracing & timeline") ----------------------
+# Master switch for the causal tracing plane: spans from submit to decode,
+# propagated through task/actor wire tuples and serve requests, exported as
+# Perfetto timelines (`ray-tpu timeline`). Unset/False is byte-identical
+# off: no contextvar writes on hot paths, no span ring, no rpc hook, and
+# the wire tuples keep their pre-tracing arity (pinned by test).
+_flag("tracing", bool, False)
+# Head-based sampling: the decision is rolled ONCE at the trace root (a
+# top-level submit or an ingress request) and carried by propagation —
+# children never re-roll. 1.0 = trace everything.
+_flag("trace_sample", float, 1.0)
+# Per-process span ring capacity (flight-recorder idiom): spans beyond this
+# between metrics-flush ticks drop oldest-first.
+_flag("trace_buffer_spans", int, 4096)
+# Controller-side trace index capacity: completed/evicted traces beyond
+# this are dropped from memory (persisted ones remain readable from the
+# storage plane).
+_flag("trace_max_traces", int, 512)
+# Storage-plane URI completed traces persist under (any backend; "" =
+# <session_dir>/<session>/traces). "none" disables persistence.
+_flag("trace_dir", str, "")
+# Always-sample escalation for serve requests: an UNSAMPLED request slower
+# than this records a root span anyway, so tail latency outliers stay
+# visible under tight head sampling. <=0 disables the escalation.
+_flag("trace_slow_s", float, 0.0)
 # --- kernels / diagnostics --------------------------------------------------
 # Decode-attention kernel selection: "pallas" / "xla" force a path, ""
 # keeps the size-based dispatch (ops/decode_attention.py

@@ -93,8 +93,27 @@ class TaskSpec:
     #: worker-side: an attempt running longer is interrupted and fails as a
     #: retryable TaskTimeoutError (system failure under max_retries).
     timeout_s: Optional[float] = None
+    #: Trace context (trace_id, parent_span_id) from the tracing plane
+    #: (README "Tracing & timeline"): set at submit when the root sampled,
+    #: carried across retries AND across the direct->controller failover
+    #: re-route so every attempt's execute span chains to one trace. None
+    #: (tracing off / unsampled) keeps every wire format at its pre-tracing
+    #: arity — the off path is byte-identical.
+    trace: Optional[tuple] = None
 
     def __getstate__(self):
+        if self.trace is None:
+            # Traceless specs keep the 26-field state: byte-identical wire/
+            # snapshot bytes with RT_TRACING unset (pinned by test).
+            return (self.task_id, self.kind, self.name, self.function_id,
+                    self.method_name, self.args, self.kwargs,
+                    self.num_returns, self.resources, self.strategy,
+                    self.max_retries, self.retry_exceptions,
+                    self.runtime_env, self.owner_id, self.owner_addr,
+                    self.actor_id, self.max_restarts, self.max_task_retries,
+                    self.max_concurrency, self.actor_name, self.namespace,
+                    self.get_if_exists, self.lifetime, self.attempt,
+                    self.concurrency_groups, self.timeout_s)
         return (self.task_id, self.kind, self.name, self.function_id,
                 self.method_name, self.args, self.kwargs, self.num_returns,
                 self.resources, self.strategy, self.max_retries,
@@ -102,7 +121,8 @@ class TaskSpec:
                 self.owner_addr, self.actor_id, self.max_restarts,
                 self.max_task_retries, self.max_concurrency, self.actor_name,
                 self.namespace, self.get_if_exists, self.lifetime,
-                self.attempt, self.concurrency_groups, self.timeout_s)
+                self.attempt, self.concurrency_groups, self.timeout_s,
+                self.trace)
 
     def __setstate__(self, s):
         if len(s) == 23:  # pre-'lifetime' snapshots: insert None before attempt
@@ -111,6 +131,8 @@ class TaskSpec:
             s = s + (None,)
         if len(s) == 25:  # pre-'timeout_s' snapshots
             s = s + (None,)
+        if len(s) == 26:  # pre-'trace' snapshots (and traceless specs)
+            s = s + (None,)
         (self.task_id, self.kind, self.name, self.function_id,
          self.method_name, self.args, self.kwargs, self.num_returns,
          self.resources, self.strategy, self.max_retries,
@@ -118,7 +140,8 @@ class TaskSpec:
          self.owner_addr, self.actor_id, self.max_restarts,
          self.max_task_retries, self.max_concurrency, self.actor_name,
          self.namespace, self.get_if_exists, self.lifetime,
-         self.attempt, self.concurrency_groups, self.timeout_s) = s
+         self.attempt, self.concurrency_groups, self.timeout_s,
+         self.trace) = s
 
     def clone(self) -> "TaskSpec":
         """Shallow copy with its own SchedulingStrategy. The controller
@@ -142,7 +165,8 @@ class TaskSpec:
     @classmethod
     def for_actor_call(cls, task_id: str, method_name: str, args, kwargs,
                        num_returns: int, name: str, owner_id: str,
-                       owner_addr, actor_id: str, attempt: int = 0) -> "TaskSpec":
+                       owner_addr, actor_id: str, attempt: int = 0,
+                       trace: Optional[tuple] = None) -> "TaskSpec":
         """Cheap constructor for the actor hot path: skips dataclass default
         factories (~3us/call at n:n rates) and shares one strategy object."""
         sp = object.__new__(cls)
@@ -172,6 +196,7 @@ class TaskSpec:
         sp.attempt = attempt
         sp.concurrency_groups = None
         sp.timeout_s = None
+        sp.trace = trace
         return sp
 
     _NORMAL_CALL_STRATEGY: ClassVar["SchedulingStrategy"] = None  # set below
@@ -181,11 +206,14 @@ class TaskSpec:
         owner-side leased dispatch): frame-constant fields — owner, the
         class's resources/strategy — ride once per frame; the full 24-field
         spec pickle costs ~3x this on encode+decode at direct-dispatch
-        rates. Executor-side counterpart: `leased_task_spec`."""
-        return (self.task_id, self.function_id, self.name, self.args,  # rtcheck: wire=exec_tasks.call
+        rates. Executor-side counterpart: `leased_task_spec`. The trailing
+        trace context rides ONLY when sampled — traceless records keep the
+        11-field pre-tracing arity (byte-identical off, pinned by test)."""
+        call = (self.task_id, self.function_id, self.name, self.args,  # rtcheck: wire=exec_tasks.call
                 self.kwargs, self.num_returns, self.max_retries,
                 self.retry_exceptions, self.runtime_env or None, self.attempt,
-                self.timeout_s)
+                self.timeout_s, self.trace)
+        return call if self.trace is not None else call[:11]
 
     @classmethod
     def for_normal_call(cls, call: tuple, owner_id: str, owner_addr,
@@ -194,8 +222,10 @@ class TaskSpec:
         wire record (cheap constructor, same shape as for_actor_call)."""
         if len(call) == 10:  # pre-'timeout_s' wire records
             call = call + (None,)
+        if len(call) == 11:  # traceless records (and pre-'trace' senders)
+            call = call + (None,)
         (task_id, function_id, name, args, kwargs, num_returns, max_retries,  # rtcheck: wire=exec_tasks.call
-         retry_exceptions, runtime_env, attempt, timeout_s) = call
+         retry_exceptions, runtime_env, attempt, timeout_s, trace) = call
         sp = object.__new__(cls)
         sp.task_id = task_id
         sp.kind = NORMAL
@@ -224,14 +254,17 @@ class TaskSpec:
         sp.attempt = attempt
         sp.concurrency_groups = None
         sp.timeout_s = timeout_s
+        sp.trace = trace
         return sp
 
     def actor_call_tuple(self) -> tuple:
         """Compact wire record for `actor_calls` frames — the full 24-field
         spec pickle costs ~9us/call encode+decode and 293B; this is ~1/3 of
-        both. Frame-constant fields (owner, actor id) ride once per frame."""
-        return (self.task_id, self.method_name, self.args, self.kwargs,  # rtcheck: wire=actor_calls.call
-                self.num_returns, self.name, self.attempt)
+        both. Frame-constant fields (owner, actor id) ride once per frame.
+        The trace context rides only when sampled (see task_call_tuple)."""
+        call = (self.task_id, self.method_name, self.args, self.kwargs,  # rtcheck: wire=actor_calls.call
+                self.num_returns, self.name, self.attempt, self.trace)
+        return call if self.trace is not None else call[:7]
 
     def ref_arg_oids(self) -> list[str]:
         """Oids of by-reference arguments — the single place that knows the
@@ -275,8 +308,10 @@ TaskSpec._NORMAL_CALL_STRATEGY = SchedulingStrategy()
 
 def actor_call_spec(call: tuple, owner_id: str, owner_addr, actor_id: str) -> TaskSpec:
     """Rebuild an executor-side spec from an `actor_calls` wire record."""
-    task_id, method_name, args, kwargs, num_returns, name, attempt = call  # rtcheck: wire=actor_calls.call
+    if len(call) == 7:  # traceless records (and pre-'trace' senders)
+        call = call + (None,)
+    task_id, method_name, args, kwargs, num_returns, name, attempt, trace = call  # rtcheck: wire=actor_calls.call
     return TaskSpec.for_actor_call(
         task_id, method_name, args, kwargs, num_returns, name,
         owner_id, tuple(owner_addr) if owner_addr else None, actor_id,
-        attempt=attempt)
+        attempt=attempt, trace=trace)

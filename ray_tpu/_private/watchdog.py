@@ -116,12 +116,13 @@ _exec_lock = threading.Lock()
 _local = threading.local()
 
 
-def task_begin(task_id: str, name: str, attempt: int, kind: str) -> None:
+def task_begin(task_id: str, name: str, attempt: int, kind: str,
+               trace_id: str | None = None) -> None:
     if not _armed:
         return
     now = time.monotonic()
     st = {"task_id": task_id, "name": name, "attempt": attempt, "kind": kind,
-          "started": now, "last_progress": now}
+          "started": now, "last_progress": now, "trace_id": trace_id}
     ident = threading.get_ident()
     _local.state = st
     with _exec_lock:
@@ -192,6 +193,9 @@ def build_report(st: dict, stage: str, *, worker_id: str, node_id: str,
         "name": st.get("name"),
         "attempt": st.get("attempt", 0),
         "kind": st.get("kind"),
+        # Tracing linkage: a stalled TRACED task's report names its trace,
+        # so `ray-tpu stalls` links straight to `ray-tpu timeline --trace`.
+        "trace_id": st.get("trace_id"),
         "worker_id": worker_id,
         "node_id": node_id,
         "pid": pid,
@@ -227,6 +231,10 @@ class Watchdog:
         self._pid = os.getpid()
         # (task_id, attempt) -> set of stages already emitted.
         self._emitted: dict[tuple, set] = {}
+        # (task_id, attempt) -> trace id minted by the always-sample
+        # escalation for UNSAMPLED stalled tasks (tracing.escalation_root);
+        # later stages of the same attempt reuse it.
+        self._esc_traces: dict[tuple, str] = {}
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
 
@@ -286,6 +294,12 @@ class Watchdog:
                             st, stage, worker_id=self.worker_id,
                             node_id=self.node_id, pid=self._pid,
                             session_id=self.session_id, silence_s=silence)
+                        if rep.get("trace_id") is None:
+                            # Always-sample escalation: an UNSAMPLED (or
+                            # untraced-root) stalled task still gets a
+                            # trace root so the report links to a
+                            # timeline. No-op with tracing off.
+                            rep["trace_id"] = self._stall_trace(key, st)
                         delivered = self.on_report(rep) is not False
                     except Exception:
                         delivered = False
@@ -296,8 +310,22 @@ class Watchdog:
         # Prune ladder bookkeeping of finished attempts.
         for key in [k for k in self._emitted if k not in live_keys]:
             self._emitted.pop(key, None)
+        for key in [k for k in self._esc_traces if k not in live_keys]:
+            self._esc_traces.pop(key, None)
         if self.on_beacon is not None:
             try:
                 self.on_beacon(beacon_task, worst_silence)
             except Exception:
                 pass
+
+    def _stall_trace(self, key: tuple, st: dict):
+        """Mint (once per attempt) an escalation trace root for a stalled
+        task that carries no sampled trace context."""
+        tid = self._esc_traces.get(key)
+        if tid is None:
+            from ray_tpu._private import tracing
+
+            tid = tracing.escalation_root(st)
+            if tid is not None:
+                self._esc_traces[key] = tid
+        return tid

@@ -29,6 +29,7 @@ from typing import Any, Optional
 
 from ray_tpu import exceptions as exc
 from ray_tpu._private import device_store, rpc
+from ray_tpu._private import tracing as _tracing
 from ray_tpu._private.ids import ObjectID, TaskID, WorkerID
 from ray_tpu._private.lease import LeaseManager, _record_dispatch
 from ray_tpu._private.object_store import LocalStore
@@ -485,9 +486,25 @@ class Worker:
             CONFIG.load_snapshot(rep["config"])
 
         self.io.run(_go(), timeout=CONFIG.connect_timeout_s)
+        # Tracing plane: re-resolve RT_TRACING now the cluster snapshot is
+        # in (and arm/disarm the rpc frame hook accordingly).
+        _tracing.refresh()
 
     def disconnect(self):
         self._shutdown = True
+        # Final metrics/span flush BEFORE tearing anything down: without it
+        # a short-lived driver loses up to one flush interval of trailing
+        # counters and spans (the flusher refuses to push once _shutdown is
+        # set — flush_on_shutdown forces the last batch out and fences it
+        # with an acked ping so the controller has processed it).
+        import sys as _sys
+
+        _m = _sys.modules.get("ray_tpu.util.metrics")
+        if _m is not None:
+            try:
+                _m.flush_on_shutdown()
+            except Exception:
+                pass
         try:
             self.lease_mgr.shutdown()
         except Exception:
@@ -557,6 +574,18 @@ class Worker:
                     "register", kind="client", worker_id=self.worker_id,
                     mode=self.mode, address=self.server_addr, _timeout=10)
                 self.controller = conn
+                # A restarted controller lost the histogram-boundary decls
+                # this process registered (they ride ONE record per
+                # session): forget the declared set so the next observe of
+                # each histogram re-declares to the fresh controller.
+                import sys as _sys
+
+                _m = _sys.modules.get("ray_tpu.util.metrics")
+                if _m is not None:
+                    try:
+                        _m._hist_declared.clear()
+                    except Exception:
+                        pass
                 h = self.ctrl_reconnected_handler
                 if h is not None:
                     try:
@@ -650,7 +679,8 @@ class Worker:
                 self.actor_batch_handler(conn, [
                     TaskSpec.for_actor_call(
                         c[0], c[1], c[2], c[3], c[4], c[5],
-                        owner_id, owner_addr, actor_id, attempt=c[6])
+                        owner_id, owner_addr, actor_id, attempt=c[6],
+                        trace=(c[7] if len(c) > 7 else None))
                     for c in a["calls"]])
         elif method == "actor_tasks":  # full-spec form (compat)
             if self.actor_push_handler is not None:
@@ -1786,6 +1816,11 @@ class Worker:
             owner_addr=self.server_addr,
             timeout_s=timeout_s,
         )
+        if _tracing.enabled():
+            # Submit span + wire context: inside a traced task this chains
+            # to the executing span; at top level it roots a new trace
+            # (head-based RT_TRACE_SAMPLE decision).
+            spec.trace = _tracing.on_submit(spec.name, task_id)
         refs = []
         for oid in spec.return_object_ids():
             self._resolutions[oid] = _Resolution()
@@ -1923,6 +1958,8 @@ class Worker:
             lifetime=lifetime,
             concurrency_groups=dict(concurrency_groups) if concurrency_groups else None,
         )
+        if _tracing.enabled():
+            spec.trace = _tracing.on_submit(spec.name, spec.task_id)
         rep = self.io.run(self.controller.call("create_actor", spec=spec))
         return rep["actor_id"]
 
@@ -1951,6 +1988,8 @@ class Worker:
         spec = TaskSpec.for_actor_call(
             task_id, method_name, enc_args, enc_kwargs, num_returns,
             name or method_name, self.worker_id, self.server_addr, actor_id)
+        if _tracing.enabled():
+            spec.trace = _tracing.on_submit(spec.name, task_id)
         refs = []
         for oid in spec.return_object_ids():
             self._resolutions[oid] = _Resolution()
@@ -1984,6 +2023,10 @@ class Worker:
     def _apply_actor_reply(self, spec: TaskSpec, rep: tuple):
         # rep: (task_id, attempt, results, error, retryable, exec_failure)
         _tid, _attempt, results, error, _retryable, exec_failure = rep  # rtcheck: wire=tasks_done.item
+        if spec.trace is not None:
+            _tracing.record_instant(
+                spec.trace, "result", "result",
+                {"task": spec.task_id, "ok": error is None})
         if exec_failure and not results:
             # The actor's executor layer failed before results were packaged:
             # fail the refs rather than leaving the caller blocked forever.

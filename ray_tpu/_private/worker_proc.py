@@ -24,6 +24,7 @@ import time
 import traceback
 
 from ray_tpu._private import device_store, rpc, watchdog
+from ray_tpu._private import tracing as _tracing
 from ray_tpu._private import runtime_env as _rtenv
 from ray_tpu._private.rtconfig import CONFIG
 from ray_tpu._private.serialization import dumps_oob, serialize
@@ -619,6 +620,11 @@ class WorkerProc:
         value = None
         streaming = spec.num_returns == STREAMING
         gen_count = 0
+        # Execute span + context for async actor methods: set inside this
+        # coroutine, the contextvar scopes to it — everything the method
+        # does (engine submits, nested calls, streamed iteration) chains
+        # under the execute span without leaking to sibling requests.
+        trace_h = _tracing.task_execute_begin(spec)
         t0 = time.time()
         try:
             method = getattr(self.actor_instance, spec.method_name)
@@ -639,6 +645,7 @@ class WorkerProc:
                     error_blob = gerr
         except BaseException as e:  # noqa: BLE001
             error_blob = self._make_error_blob(spec, e)
+        _tracing.task_execute_end(trace_h, ok=error_blob is None)
         self._record_event(spec, t0, time.time(), error_blob is None)
         if streaming:
             return {"results": self._package_stream_completion(
@@ -986,7 +993,9 @@ class WorkerProc:
         undo_env = lambda: None  # noqa: E731
         self._current_task_id = spec.task_id
         self._current_attempt = spec.attempt
-        watchdog.task_begin(spec.task_id, spec.name, spec.attempt, spec.kind)
+        trace_h = _tracing.task_execute_begin(spec)
+        watchdog.task_begin(spec.task_id, spec.name, spec.attempt, spec.kind,
+                            trace_id=spec.trace[0] if spec.trace else None)
         timer = self._arm_task_timeout(spec)
         t0 = time.time()
         try:
@@ -1028,6 +1037,7 @@ class WorkerProc:
             self._timed_out.discard((spec.task_id, spec.attempt))
             self._current_task_id = None
             watchdog.task_end(error_blob is None)
+            _tracing.task_execute_end(trace_h, ok=error_blob is None)
             self._record_event(spec, t0, time.time(), error_blob is None)
             if spec.kind != ACTOR_CREATE:  # dedicated actor procs keep their env
                 undo_env()
@@ -1142,7 +1152,9 @@ class WorkerProc:
         undo_env = lambda: None  # noqa: E731
         self._current_task_id = spec.task_id
         self._current_attempt = spec.attempt
-        watchdog.task_begin(spec.task_id, spec.name, spec.attempt, spec.kind)
+        trace_h = _tracing.task_execute_begin(spec)
+        watchdog.task_begin(spec.task_id, spec.name, spec.attempt, spec.kind,
+                            trace_id=spec.trace[0] if spec.trace else None)
         timer = self._arm_task_timeout(spec)
         t0 = time.time()
         try:
@@ -1174,6 +1186,7 @@ class WorkerProc:
             self._timed_out.discard((spec.task_id, spec.attempt))
             self._current_task_id = None
             watchdog.task_end(error_blob is None)
+            _tracing.task_execute_end(trace_h, ok=error_blob is None)
             self._record_event(spec, t0, time.time(), error_blob is None)
             undo_env()
             for k, old in saved_env.items():
@@ -1255,8 +1268,10 @@ class WorkerProc:
         gen_count = 0
         # Progress beacon for sync actor methods (threaded/default paths;
         # async methods ride the actor loop and are not thread-attributable).
+        trace_h = _tracing.task_execute_begin(spec)
         watchdog.task_begin(spec.task_id, spec.name, spec.attempt,
-                            spec.kind)
+                            spec.kind,
+                            trace_id=spec.trace[0] if spec.trace else None)
         t0 = time.time()
         try:
             if self.actor_instance is None:
@@ -1276,6 +1291,7 @@ class WorkerProc:
         except BaseException as e:  # noqa: BLE001
             error_blob = self._make_error_blob(spec, e)
         watchdog.task_end(error_blob is None)
+        _tracing.task_execute_end(trace_h, ok=error_blob is None)
         self._record_event(spec, t0, time.time(), error_blob is None)
         if streaming:
             return {"results": self._package_stream_completion(

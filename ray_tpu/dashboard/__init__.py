@@ -41,6 +41,7 @@ _INDEX_HTML = """<!doctype html><html><head><title>ray_tpu dashboard</title>
 <h2>Jobs</h2><table id="jobs"></table>
 <h2>Recent tasks</h2><table id="tasks"></table>
 <p><a href="/api/timeline">timeline</a> (chrome trace; load in Perfetto) &middot;
+<a href="/api/traces">traces</a> (causal spans; RT_TRACING=1) &middot;
 <a href="/metrics">prometheus /metrics</a></p>
 <script>
 const esc=(v)=>String(v).replace(/&/g,"&amp;").replace(/</g,"&lt;")
@@ -122,6 +123,7 @@ class Dashboard:
             app.router.add_get("/api/objects", self._objects)
             app.router.add_get("/api/jobs", self._jobs)
             app.router.add_get("/api/timeline", self._timeline)
+            app.router.add_get("/api/traces", self._traces)
             app.router.add_get("/api/stacks", self._stacks)
             app.router.add_get("/api/metrics", self._metrics_json)
             app.router.add_get("/metrics", self._metrics_prom)
@@ -268,6 +270,32 @@ class Dashboard:
                 lines.append(f"{name}{label} {m['value']}")
         return web.Response(text="\n".join(lines) + "\n",
                             content_type="text/plain")
+
+    async def _traces(self, request):
+        """Distributed-tracing index (README "Tracing & timeline"):
+        /api/traces lists indexed traces; /api/traces?trace_id=... returns
+        one trace rendered as Chrome-trace-event JSON (load the
+        `traceEvents` doc in Perfetto), plus the raw spans."""
+        from aiohttp import web
+
+        tid = request.query.get("trace_id")
+        if not tid:
+            limit = int(request.query.get("limit", 1000))
+            rep = await self._a_call("list_traces", limit=limit)
+            return web.json_response({"traces": rep["traces"]})
+        rep = await self._a_call("get_trace", trace_id=tid)
+        if not rep.get("found"):
+            return web.json_response(
+                {"error": f"trace {tid!r} not found"}, status=404)
+        from ray_tpu.scripts.cli import _chrome_trace_events
+
+        events = _chrome_trace_events(rep["spans"])
+        events.sort(key=lambda e: e.get("ts", 0.0))
+        return web.json_response({
+            "trace_id": rep.get("trace_id"), "name": rep.get("name"),
+            "start": rep.get("start"), "end": rep.get("end"),
+            "complete": rep.get("complete"), "spans": rep["spans"],
+            "traceEvents": events, "displayTimeUnit": "ms"})
 
     async def _timeline(self, request):
         from aiohttp import web

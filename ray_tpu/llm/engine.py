@@ -29,10 +29,13 @@ import itertools
 import logging
 import queue
 import threading
+import time
 from dataclasses import dataclass
 from typing import Any, Optional
 
 import numpy as np
+
+from ray_tpu._private import tracing as _tracing
 
 logger = logging.getLogger(__name__)
 
@@ -63,6 +66,11 @@ class GenStream:
         self._q: "queue.Queue" = queue.Queue()
         self.finish_reason: Optional[str] = None
         self.closed = False
+        # Trace context captured at submit (README "Tracing & timeline"):
+        # the engine scheduler thread parents its per-iteration spans —
+        # prefill, chunk dispatch, host-sync readback — to the submitting
+        # request's trace, making each per-token host round trip visible.
+        self.trace: Optional[tuple] = None
 
     def close(self):
         """Consumer abandoned the request (client disconnect): the engine
@@ -350,6 +358,8 @@ class ContinuousEngine:
                 f"prompt ({len(prompt)}) + max_tokens ({sampling.max_tokens}) "
                 f"exceeds max_seq ({self.cfg.max_seq})")
         stream = GenStream(next(self._req_counter), len(prompt))
+        if _tracing.enabled():
+            stream.trace = _tracing.current()
         # The _running check and the enqueue must be ONE atomic step
         # against shutdown()'s flag flip: a submit that slips between the
         # check and the put could otherwise queue a stream after the
@@ -489,8 +499,13 @@ class ContinuousEngine:
                 except queue.Empty:
                     break
                 try:
+                    t_adm = time.time()
                     first_dev = self._admit_async(free, prompt, sampling,
                                                   stream)
+                    _tracing.record_span_in(
+                        stream.trace, "engine.prefill", "engine", t_adm,
+                        time.time(),
+                        {"slot": free, "prompt_len": len(prompt)})
                     admits.append((free, first_dev))
                     # Merge into the device mirrors without a sync.
                     self._toks_dev = self._toks_dev.at[free].set(first_dev)
@@ -531,13 +546,24 @@ class ContinuousEngine:
                 greedy = all(
                     self._slots[i].sampling.temperature <= 0.0
                     for i in active)
+                # Per-iteration tracing (README "Tracing & timeline"): bind
+                # the decode loop's spans to the oldest active TRACED
+                # request — in the one-request case (the BENCH_r05 gap's
+                # shape) every dispatch and host sync lands in its timeline.
+                tctx = next((self._slots[i].stream.trace for i in active
+                             if self._slots[i].stream.trace is not None),
+                            None)
                 try:
+                    t_disp = time.time()
                     self._cache, self._keys, toks_out, lens_out = \
                         self._chunk(
                             self.params, self._cache,
                             self._toks_dev, self._lens_dev,
                             self._keys, self._temps_dev,
                             self._topks_dev, self._topps_dev, n, greedy)
+                    _tracing.record_span_in(
+                        tctx, "engine.dispatch_chunk", "engine", t_disp,
+                        time.time(), {"tokens": n, "active": len(active)})
                     # Chain on device; mirror lengths on host (every slot
                     # steps n times — deterministic, no read needed).
                     self._toks_dev = toks_out[:, n - 1]
@@ -565,6 +591,26 @@ class ContinuousEngine:
                         col = col.at[slot, 0].set(fdev)
                     parts.append(col)
                 parts.extend(c[0] for c in q)
+                # The host-sync readback: THE per-iteration host-link round
+                # trip the decode loop pays (the 22x end-to-end gap in
+                # BENCH_r05 is made of these). Span it against the oldest
+                # traced in-flight request + the decode-step histogram.
+                sync_ctx = None
+                if _tracing.enabled():
+                    sync_ctx = next(
+                        (self._slots[i].stream.trace
+                         for _t, p_active, _n, _tag in q for i in p_active
+                         if self._slots[i] is not None
+                         and self._slots[i].stream.trace is not None),
+                        None)
+                    if sync_ctx is None:
+                        sync_ctx = next(
+                            (self._slots[s].stream.trace
+                             for s, _f in firsts
+                             if self._slots[s] is not None
+                             and self._slots[s].stream.trace is not None),
+                            None)
+                t_sync = time.time()
                 try:
                     all_np = np.asarray(
                         parts[0] if len(parts) == 1
@@ -580,6 +626,18 @@ class ContinuousEngine:
                                 self._slots[i].stream._q.put(e)
                                 self._retire(i)
                     all_np = None
+                if sync_ctx is not None and all_np is not None:
+                    t_end = time.time()
+                    _tracing.record_span_in(
+                        sync_ctx, "engine.host_sync", "engine", t_sync,
+                        t_end, {"chunks": len(q),
+                                "cols": int(all_np.shape[1])})
+                    try:
+                        from ray_tpu.util import metrics as _metrics
+
+                        _metrics.DECODE_STEP_SECONDS.observe(t_end - t_sync)
+                    except Exception:
+                        pass
                 off = 0
                 if firsts and all_np is not None:
                     for slot, _f in firsts:

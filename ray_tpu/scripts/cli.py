@@ -367,11 +367,93 @@ def cmd_stalls(args) -> int:
               f"{str(r.get('node_id') or '-')[:10]:<10} "
               f"{(r.get('pid') or '-'):>7}  "
               f"{(r.get('reason') or '')[:60]}")
+        if r.get("trace_id"):
+            print(f"       trace: {r['trace_id']}  "
+                  f"(ray-tpu timeline --trace {r['trace_id'][:12]})")
         if args.verbose:
             if r.get("flight_path"):
                 print(f"       flight dump: {r['flight_path']}")
             for ev in r.get("events") or []:
                 print(f"       {ev}")
+    return 0
+
+
+def _chrome_trace_events(spans: list) -> list[dict]:
+    """Convert controller span dicts to Chrome-trace/Perfetto events:
+    complete "X" events laned by (worker process, thread), plus "M"
+    process-name metadata. Returned unsorted; the caller sorts by ts (the
+    catapult importer wants monotonic timestamps)."""
+    events: list[dict] = []
+    pids: dict[str, int] = {}
+    for sp in spans:
+        w = str(sp.get("w") or "?")
+        pid = pids.get(w)
+        if pid is None:
+            pid = pids[w] = len(pids) + 1
+            events.append({"ph": "M", "name": "process_name", "pid": pid,
+                           "args": {"name": f"worker {w} "
+                                            f"(os pid {sp.get('pid', '?')})"}})
+        start = float(sp.get("a") or 0.0)
+        end = float(sp.get("b") or start)
+        args = {"trace_id": sp.get("t"), "span_id": sp.get("s"),
+                "parent": sp.get("p")}
+        args.update(sp.get("at") or {})
+        events.append({
+            "ph": "X",
+            "name": str(sp.get("n") or "?"),
+            "cat": str(sp.get("k") or "span"),
+            "pid": pid,
+            "tid": int(sp.get("tid") or 0),
+            "ts": start * 1e6,
+            "dur": max(1.0, (end - start) * 1e6),
+            "args": args,
+        })
+    return events
+
+
+def cmd_timeline(args) -> int:
+    """`ray-tpu timeline` — export traced request/task timelines (README
+    "Tracing & timeline") as Chrome-trace-event JSON that loads directly in
+    Perfetto (ui.perfetto.dev) or chrome://tracing. Selects one trace
+    (--trace ID, unique prefixes ok) or the N most recent (--last, default
+    all indexed); requires the cluster to run with RT_TRACING=1."""
+    address = _resolve_address(args)
+    if args.trace:
+        ids = [args.trace]
+    else:
+        rows = _rpc_call(address, "list_traces", limit=100_000)["traces"]
+        rows.sort(key=lambda r: r.get("start") or 0)
+        if args.last is not None:
+            rows = rows[-args.last:]
+        ids = [r["trace_id"] for r in rows]
+    if not ids:
+        print("no traces indexed (is the cluster running with RT_TRACING=1 "
+              "and has a sampled request completed?)", file=sys.stderr)
+        return 1
+    events: list[dict] = []
+    missing = 0
+    for tid in ids:
+        rep = _rpc_call(address, "get_trace", trace_id=tid)
+        if not rep.get("found"):
+            missing += 1
+            continue
+        events.extend(_chrome_trace_events(rep["spans"]))
+    if missing:
+        print(f"warning: {missing} trace(s) not found (evicted and not "
+              f"persisted?)", file=sys.stderr)
+    if not events:
+        print("no spans found for the selected trace(s)", file=sys.stderr)
+        return 1
+    events.sort(key=lambda e: e.get("ts", 0.0))
+    doc = {"traceEvents": events, "displayTimeUnit": "ms"}
+    if args.output:
+        with open(args.output, "w") as f:
+            json.dump(doc, f)
+        nspans = sum(1 for e in events if e["ph"] == "X")
+        print(f"wrote {nspans} span(s) from {len(ids) - missing} trace(s) "
+              f"to {args.output} — open in https://ui.perfetto.dev")
+    else:
+        print(json.dumps(doc))
     return 0
 
 
@@ -488,6 +570,25 @@ def main(argv=None) -> int:
     pl.add_argument("--verbose", action="store_true",
                     help="show flight-recorder tails and dump paths")
     pl.set_defaults(fn=cmd_stalls)
+
+    pm = sub.add_parser(
+        "timeline",
+        help="export traced timelines as Perfetto/Chrome-trace JSON",
+        description="Export the distributed-tracing plane's causal spans "
+                    "(submit -> dispatch -> execute -> RPC/collective/"
+                    "storage ops -> engine decode iterations) as Chrome-"
+                    "trace-event JSON. Load the output in "
+                    "https://ui.perfetto.dev or chrome://tracing. Requires "
+                    "a cluster running with RT_TRACING=1; sample with "
+                    "RT_TRACE_SAMPLE.")
+    pm.add_argument("--address", default=None)
+    pm.add_argument("--trace", default=None,
+                    help="one trace id (unique prefixes accepted)")
+    pm.add_argument("--last", type=int, default=None,
+                    help="export only the N most recent traces")
+    pm.add_argument("-o", "--output", default=None,
+                    help="write JSON here (default: stdout)")
+    pm.set_defaults(fn=cmd_timeline)
 
     pn = sub.add_parser(
         "lint",

@@ -10,11 +10,13 @@ response awaits interleave without threads-per-request.
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import json
 import logging
 from typing import Optional
 
 import ray_tpu
+from ray_tpu._private import tracing as _tracing
 from ray_tpu.serve._private.replica import Request
 from ray_tpu.serve._private.router import get_router, resolver_for
 
@@ -115,9 +117,21 @@ class Proxy:
             return web.Response(status=404, text="no deployment matches path")
         _prefix, dep = m
         body = await request.read()
+        # Trace root: an ingress request roots its own trace (head-based
+        # RT_TRACE_SAMPLE; slow unsampled requests escalate via
+        # RT_TRACE_SLOW_S in end_request). The context set here is copied
+        # into the assign executor hop below, so the actor-call submit —
+        # and everything downstream of the replica — chains under it.
+        trh = _tracing.start_request(f"http {request.method} {request.path}")
+        headers = dict(request.headers)
+        tid = _tracing.request_trace_id(trh)
+        if tid is not None:
+            # Propagated in-band for deployments that want to tag logs /
+            # downstream calls with the request's trace.
+            headers["rt-trace-id"] = tid
         req = Request(method=request.method, path=request.path,
                       query=dict(request.query),
-                      headers=dict(request.headers), body=body)
+                      headers=headers, body=body)
         router = get_router(self.controller_name, dep)
         loop = asyncio.get_event_loop()
         # reference multiplex header: routes to a replica with the model hot.
@@ -134,43 +148,60 @@ class Proxy:
             except Exception:
                 want_stream = False
         if want_stream:
-            return await self._handle_streaming(request, req, router,
-                                                model_id, loop)
+            try:
+                return await self._handle_streaming(request, req, router,
+                                                    model_id, loop)
+            finally:
+                _tracing.end_request(
+                    trh, f"http {request.method} {request.path}",
+                    {"deployment": dep, "stream": True})
 
         async def _once():
             # assign only blocks when there are no replicas (rare), so the
             # executor thread is held for microseconds, not the request
             # duration; the result await costs no thread at all.
+            # run_in_executor does NOT propagate contextvars (the trace
+            # context, like the multiplexed id in replica.py): copy it in.
+            pctx = contextvars.copy_context()
             ref = await loop.run_in_executor(
-                None, lambda: router.assign("__call__", (req,), {},
-                                            multiplexed_model_id=model_id))
+                None, lambda: pctx.run(
+                    router.assign, "__call__", (req,), {},
+                    multiplexed_model_id=model_id))
             return await self._resolver.submit(ref)
 
         try:
-            result = await _once()
-        except Exception as e:
-            from ray_tpu.exceptions import ActorDiedError, WorkerCrashedError
+            try:
+                result = await _once()
+            except Exception as e:
+                from ray_tpu.exceptions import (
+                    ActorDiedError,
+                    WorkerCrashedError,
+                )
 
-            if isinstance(e, (ActorDiedError, WorkerCrashedError)):
-                # replica died mid-request: retry once on a survivor
-                try:
-                    result = await _once()
-                    return self._to_response(result)
-                except Exception as e2:  # noqa: F841
-                    e = e2
-            logger.error("serve proxy error: %r", e)
-            return web.Response(status=500, text=repr(e))
-        return self._to_response(result)
+                if isinstance(e, (ActorDiedError, WorkerCrashedError)):
+                    # replica died mid-request: retry once on a survivor
+                    try:
+                        result = await _once()
+                        return self._to_response(result)
+                    except Exception as e2:  # noqa: F841
+                        e = e2
+                logger.error("serve proxy error: %r", e)
+                return web.Response(status=500, text=repr(e))
+            return self._to_response(result)
+        finally:
+            _tracing.end_request(trh, f"http {request.method} {request.path}",
+                                 {"deployment": dep})
 
     async def _handle_streaming(self, request, req, router, model_id, loop):
         """SSE response: one `data:` event per streamed item, then [DONE]."""
         from aiohttp import web
 
         try:
+            pctx = contextvars.copy_context()  # carry the trace context
             gen = await loop.run_in_executor(
-                None, lambda: router.assign(
-                    "__call__", (req,), {}, multiplexed_model_id=model_id,
-                    streaming=True))
+                None, lambda: pctx.run(
+                    router.assign, "__call__", (req,), {},
+                    multiplexed_model_id=model_id, streaming=True))
         except Exception as e:
             logger.error("serve proxy stream assign error: %r", e)
             return web.Response(status=500, text=repr(e))

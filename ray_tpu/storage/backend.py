@@ -180,14 +180,27 @@ def parent(uri: str) -> str:
 
 
 # ------------------------------------------------- module-level conveniences
+# The write/read/rename conveniences every consumer rides (controller
+# snapshots, train checkpoints, tune state, workflow memoization, flight
+# dumps) carry tracing spans: inside a traced context a storage op becomes
+# a `storage.*` span with scheme + byte count, so checkpoint stalls and
+# slow backends show up in the request/step timeline. Zero-cost when
+# tracing is off or the context unsampled (see _private/tracing.span).
+from ray_tpu._private import tracing as _tracing  # noqa: E402
+
+
 def put(uri: str, data: Parts) -> int:
     be, p = get_backend(uri)
-    return be.put(p, data)
+    with _tracing.span("storage.put", "storage", {"scheme": be.scheme or
+                                                  scheme_of(uri)}):
+        return be.put(p, data)
 
 
 def get_bytes(uri: str) -> bytes:
     be, p = get_backend(uri)
-    return be.get(p)
+    with _tracing.span("storage.get", "storage", {"scheme": be.scheme or
+                                                  scheme_of(uri)}):
+        return be.get(p)
 
 
 def exists(uri: str) -> bool:
@@ -216,7 +229,9 @@ def rename(src_uri: str, dst_uri: str) -> None:
     if be is not be2:
         raise StorageError("rename must stay within one backend "
                            f"({src_uri} -> {dst_uri})")
-    be.rename(src, dst)
+    with _tracing.span("storage.rename", "storage",
+                       {"scheme": be.scheme or scheme_of(src_uri)}):
+        be.rename(src, dst)
 
 
 def makedirs(uri: str) -> None:

@@ -44,6 +44,7 @@ from contextlib import contextmanager
 from typing import Any, Callable, Optional, Union
 
 from ray_tpu import storage
+from ray_tpu._private import tracing as _tracing
 from ray_tpu.storage import StorageNotFoundError, StorageTransientError
 
 logger = logging.getLogger(__name__)
@@ -341,17 +342,24 @@ def save_async(state, dir_uri: str, *, step=None, rank: int = 0,
     from ray_tpu._private.rtconfig import CONFIG
 
     arrays: list = []
-    skeleton = _walk_extract(state, (), arrays)
-    plan = {
-        "kind": "state",
-        "dir": dir_uri,
-        "step": step,
-        "rank": rank,
-        "world": world_size,
-        "leaves": [_snapshot_leaf(p, a) for p, a in arrays],
-        "skeleton": skeleton if rank == 0 else None,
-        "start": time.time(),
-    }
+    # Stage 1 of the traced save: the synchronous device->host snapshot
+    # (the only part on the caller's step path when async).
+    with _tracing.span("ckpt.snapshot", "ckpt",
+                       {"step": step, "rank": rank}):
+        skeleton = _walk_extract(state, (), arrays)
+        plan = {
+            "kind": "state",
+            "dir": dir_uri,
+            "step": step,
+            "rank": rank,
+            "world": world_size,
+            "leaves": [_snapshot_leaf(p, a) for p, a in arrays],
+            "skeleton": skeleton if rank == 0 else None,
+            "start": time.time(),
+        }
+    # The writer thread carries no contextvar: hand it the caller's trace
+    # context so write/commit stages land in the same trace.
+    plan["trace"] = _tracing.current() if _tracing.enabled() else None
     stats: dict = {}
     if CONFIG.ckpt_async:
         fut = _writer_pool().submit(_write_plan, plan, stats)
@@ -370,14 +378,17 @@ def save(state, dir_uri: str, *, step=None, rank: int = 0,
     (other ranks). Same bytes as save_async."""
     plan_stats: dict = {}
     arrays: list = []
-    skeleton = _walk_extract(state, (), arrays)
-    plan = {
-        "kind": "state", "dir": dir_uri, "step": step, "rank": rank,
-        "world": world_size,
-        "leaves": [_snapshot_leaf(p, a) for p, a in arrays],
-        "skeleton": skeleton if rank == 0 else None,
-        "start": time.time(),
-    }
+    with _tracing.span("ckpt.snapshot", "ckpt",
+                       {"step": step, "rank": rank}):
+        skeleton = _walk_extract(state, (), arrays)
+        plan = {
+            "kind": "state", "dir": dir_uri, "step": step, "rank": rank,
+            "world": world_size,
+            "leaves": [_snapshot_leaf(p, a) for p, a in arrays],
+            "skeleton": skeleton if rank == 0 else None,
+            "start": time.time(),
+        }
+    plan["trace"] = _tracing.current() if _tracing.enabled() else None
     return _write_plan(plan, plan_stats)
 
 
@@ -399,6 +410,7 @@ def upload_directory_async(src_dir: str, dest_uri: str, *,
                 files[rel] = f.read()
     plan = {"kind": "directory", "dir": dest_uri, "step": step,
             "rank": 0, "world": 1, "files": files, "start": time.time()}
+    plan["trace"] = _tracing.current() if _tracing.enabled() else None
     stats: dict = {}
     if CONFIG.ckpt_async:
         fut = _writer_pool().submit(_write_plan, plan, stats)
@@ -422,6 +434,8 @@ def _write_plan(plan: dict, stats: dict) -> dict:
     committing rank — merge + write MANIFEST.json last, then run
     retention/GC and mint metrics."""
     t0 = time.perf_counter()
+    tctx = plan.get("trace")
+    t_write = time.time()
     d = plan["dir"]
     rank, world = plan["rank"], plan["world"]
     marker = storage.join(d, f"_inprogress_r{rank}")
@@ -441,7 +455,13 @@ def _write_plan(plan: dict, stats: dict) -> dict:
         manifest = {"format": _FORMAT, "kind": "directory",
                     "step": plan["step"], "created": time.time(),
                     "world_size": 1, "files": files_meta, "bytes": total}
+        _tracing.record_span_in(tctx, "ckpt.write", "ckpt", t_write,
+                                time.time(),
+                                {"step": plan["step"], "bytes": total})
+        t_c = time.time()
         _commit(d, rank, manifest, t0, stats)
+        _tracing.record_span_in(tctx, "ckpt.commit", "ckpt", t_c,
+                                time.time(), {"step": plan["step"]})
         return manifest
 
     # ---- state checkpoint: shard files + tree + wmeta ---------------------
@@ -481,6 +501,9 @@ def _write_plan(plan: dict, stats: dict) -> dict:
     wmeta_uri = storage.join(d, f"_wmeta_r{rank}.json")
     _retried(lambda: storage.put(wmeta_uri, json.dumps(wmeta).encode()),
              wmeta_uri, stats)
+    _tracing.record_span_in(tctx, "ckpt.write", "ckpt", t_write, time.time(),
+                            {"step": plan["step"], "rank": rank,
+                             "bytes": total})
 
     if rank != 0:
         # This rank's shards are durable; rank 0 owns the commit.
@@ -541,7 +564,11 @@ def _merge_and_commit(plan: dict, wmeta0: dict, t0: float,
                 "tree_file": wmeta0.get("tree_file"),
                 "tree_sha1": wmeta0.get("tree_sha1"),
                 "leaves": leaves, "bytes": total}
+    t_c = time.time()
     _commit(d, 0, manifest, t0, stats)
+    _tracing.record_span_in(plan.get("trace"), "ckpt.commit", "ckpt", t_c,
+                            time.time(),
+                            {"step": plan["step"], "world": world})
     return manifest
 
 

@@ -39,6 +39,7 @@ import numpy as np
 
 from ray_tpu import exceptions as exc
 from ray_tpu._private import rpc, watchdog
+from ray_tpu._private import tracing as _tracing
 from ray_tpu._private.rtconfig import CONFIG
 from ray_tpu._private.worker import global_worker
 
@@ -250,7 +251,12 @@ def allreduce(tensor, op: str = ReduceOp.SUM, group_name: str = "default"):
         return tensor
     leaves, treedef = jax.tree_util.tree_flatten(tensor)
     arrs = [np.asarray(x) for x in leaves]
-    reduced = _ring_allreduce(g, g.seq, arrs, _REDUCERS[op])
+    # Traced task context (if any) spans the whole ring op — the 2(W-1)
+    # steps' wall time is exactly the per-step gradient-sync cost.
+    with _tracing.span("collective.allreduce", "collective",
+                       {"group": group_name, "seq": g.seq,
+                        "world": g.world_size}):
+        reduced = _ring_allreduce(g, g.seq, arrs, _REDUCERS[op])
     return jax.tree_util.tree_unflatten(treedef, reduced)
 
 
@@ -266,10 +272,12 @@ def allgather(tensor, group_name: str = "default") -> list:
     out[r] = tensor
     nxt, prv = (r + 1) % W, (r - 1) % W
     carry = pickle.dumps(tensor, protocol=5)
-    for step in range(W - 1):
-        _send_to(g, nxt, f"ag{seq}.{step}", carry)
-        carry = _recv_step(g, "allgather", f"ag{seq}.{step}", prv)
-        out[(r - 1 - step) % W] = pickle.loads(carry)
+    with _tracing.span("collective.allgather", "collective",
+                       {"group": group_name, "seq": seq, "world": W}):
+        for step in range(W - 1):
+            _send_to(g, nxt, f"ag{seq}.{step}", carry)
+            carry = _recv_step(g, "allgather", f"ag{seq}.{step}", prv)
+            out[(r - 1 - step) % W] = pickle.loads(carry)
     return out
 
 
@@ -283,13 +291,15 @@ def broadcast(tensor, src_rank: int = 0, group_name: str = "default"):
     W, r, seq = g.world_size, g.rank, g.seq
     nxt, prv = (r + 1) % W, (r - 1) % W
     tag = f"bc{seq}"
-    if r == src_rank:
-        _send_to(g, nxt, tag, pickle.dumps(tensor, protocol=5))
-        return tensor
-    blob = _recv_step(g, "broadcast", tag, prv)
-    if nxt != src_rank:
-        _send_to(g, nxt, tag, blob)
-    return pickle.loads(blob)
+    with _tracing.span("collective.broadcast", "collective",
+                       {"group": group_name, "seq": seq, "world": W}):
+        if r == src_rank:
+            _send_to(g, nxt, tag, pickle.dumps(tensor, protocol=5))
+            return tensor
+        blob = _recv_step(g, "broadcast", tag, prv)
+        if nxt != src_rank:
+            _send_to(g, nxt, tag, blob)
+        return pickle.loads(blob)
 
 
 def reducescatter(tensor, op: str = ReduceOp.SUM, group_name: str = "default"):
@@ -311,14 +321,16 @@ def barrier(group_name: str = "default"):
         return
     W, r, seq = g.world_size, g.rank, g.seq
     nxt, prv = (r + 1) % W, (r - 1) % W
-    for lap in range(2):
-        tag = f"bar{seq}.{lap}"
-        if r == 0:
-            _send_to(g, nxt, tag, b"")
-            _recv_step(g, "barrier", tag, prv)
-        else:
-            _recv_step(g, "barrier", tag, prv)
-            _send_to(g, nxt, tag, b"")
+    with _tracing.span("collective.barrier", "collective",
+                       {"group": group_name, "seq": seq, "world": W}):
+        for lap in range(2):
+            tag = f"bar{seq}.{lap}"
+            if r == 0:
+                _send_to(g, nxt, tag, b"")
+                _recv_step(g, "barrier", tag, prv)
+            else:
+                _recv_step(g, "barrier", tag, prv)
+                _send_to(g, nxt, tag, b"")
 
 
 def send(tensor, dst_rank: int, group_name: str = "default"):

@@ -39,21 +39,70 @@ def ensure_flusher() -> None:
                      name="rt-metrics-flush").start()
 
 
-def _flush_now():
+def _flush_now(force: bool = False):
     from ray_tpu._private.worker import global_worker
 
     _drain_task_dispatch()
     _drain_device_objects()
+    # Tracing spans piggyback on the metrics flush batches (README "Tracing
+    # & timeline"): one push per tick carries both — no extra connection,
+    # cadence, or frame. sys.modules gate: a process that never traced must
+    # not import (or pay for) the tracing module here.
+    import sys
+
+    spans = None
+    _tr = sys.modules.get("ray_tpu._private.tracing")
+    if _tr is not None:
+        try:
+            spans = _tr.drain() or None
+        except Exception:
+            spans = None
     with _lock:
         global _pending
-        if not _pending:
-            return
         batch, _pending = _pending, []
+    if not batch and not spans:
+        return
     w = global_worker()
-    if w is None or getattr(w, "_shutdown", False):
+    if w is None or (getattr(w, "_shutdown", False) and not force):
+        if w is not None:
+            # A background tick racing Worker.disconnect between its
+            # `_shutdown = True` and flush_on_shutdown(): put the drained
+            # records/spans BACK so the force flush still finds them —
+            # silently dropping here would re-open the tail-loss hole this
+            # path exists to close.
+            with _lock:
+                _pending[:0] = batch
+            if spans and _tr is not None:
+                try:
+                    _tr.requeue(spans)
+                except Exception:
+                    pass
         return
     try:
-        w.controller.push_threadsafe("metrics_report", records=batch)
+        if spans is not None:
+            w.controller.push_threadsafe("metrics_report", records=batch,
+                                         spans=spans)
+        else:
+            w.controller.push_threadsafe("metrics_report", records=batch)
+    except Exception:
+        pass
+
+
+def flush_on_shutdown():
+    """Best-effort FINAL flush, called from Worker.disconnect(): without it
+    a short-lived driver silently drops up to one flush interval of
+    trailing counters and spans (the flusher refuses to push once
+    `_shutdown` is set). The trailing `ping` call fences the push: both
+    ride the same FIFO connection, so when the ping returns the controller
+    has already processed the final batch."""
+    from ray_tpu._private.worker import global_worker
+
+    w = global_worker()
+    if w is None or w.controller is None:
+        return
+    _flush_now(force=True)
+    try:
+        w.io.run(w.controller.call("ping"), timeout=2)
     except Exception:
         pass
 
@@ -111,12 +160,15 @@ _last_device_stats: dict | None = None
 
 
 def reset_device_stats_cache() -> None:
-    """Forget the last-reported residency (called on worker shutdown): a
+    """Forget per-session report caches (called on worker shutdown): a
     NEW session's controller starts with no gauge state, so the first
     drain there must report even if the values happen to match the
-    previous session's final report."""
+    previous session's final report — and histogram bucket boundaries
+    (registered once per session via `histogram_decl` records) must be
+    re-declared to the fresh controller."""
     global _last_device_stats
     _last_device_stats = None
+    _hist_declared.clear()
 
 
 def _drain_device_objects() -> None:
@@ -190,6 +242,15 @@ class Gauge(Metric):
                  "value": float(value)})
 
 
+#: (name, boundaries-tuple) pairs already declared to the controller by this
+#: process. Bucket boundaries ride ONE `histogram_decl` record per pair
+#: instead of every observe — the tracing plane's hot-path histograms (RPC
+#: frame RTT, decode-step) would otherwise ship the same boundary list in
+#: every record of every flush batch. GIL-atomic set ops; a rare duplicate
+#: decl under a race is idempotent controller-side.
+_hist_declared: set = set()
+
+
 class Histogram(Metric):
     """Bucketed distribution (reference metrics.py:216)."""
 
@@ -202,9 +263,15 @@ class Histogram(Metric):
         self._boundaries = sorted(float(b) for b in boundaries)
 
     def observe(self, value: float, tags: Optional[dict] = None):
+        key = (self._name, tuple(self._boundaries))
+        if key not in _hist_declared:
+            _hist_declared.add(key)
+            _record({"kind": "histogram_decl", "name": self._name,
+                     "desc": self._description,
+                     "boundaries": self._boundaries})
         _record({"kind": "histogram", "name": self._name,
                  "desc": self._description, "tags": self._tags(tags),
-                 "value": float(value), "boundaries": self._boundaries})
+                 "value": float(value)})
 
 
 #: Tasks submitted per dispatch route (see record_task_dispatch): the
@@ -251,6 +318,21 @@ CHECKPOINT_COMMITTED = Counter(
 TASK_TIMEOUTS = Counter(
     "rt_task_timeouts_total",
     description="task attempts killed by their per-attempt timeout_s")
+
+#: Tracing-plane latency histograms (README "Tracing & timeline"), observed
+#: ONLY inside sampled trace contexts — the unsampled hot path mints no
+#: records. Frame RTT catches control-plane hops a span tree summarizes;
+#: decode-step is the serve->engine host-link sync the BENCH_r05 22x gap
+#: hides in (each observation is one engine host readback round trip).
+RPC_FRAME_SECONDS = Histogram(
+    "rt_rpc_frame_seconds",
+    description="traced RPC request round-trip time",
+    boundaries=[0.0002, 0.001, 0.005, 0.02, 0.1, 0.5, 2.0, 10.0],
+    tag_keys=("method",))
+DECODE_STEP_SECONDS = Histogram(
+    "rt_decode_step_seconds",
+    description="llm engine host-sync readback duration per decode drain",
+    boundaries=[0.0005, 0.002, 0.01, 0.05, 0.2, 1.0, 5.0])
 
 #: Stall escalations are aggregated controller-side from StallReports
 #: (`rt_stalls_total{stage=warn|dump|kill}` — see controller._p_stall_report);

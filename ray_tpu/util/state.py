@@ -84,6 +84,22 @@ def list_stalls(limit: int = 1000) -> list[dict]:
     return _call("list_stalls", limit=limit)["stalls"]
 
 
+def list_traces(limit: int = 1000) -> list[dict]:
+    """Traces the controller has indexed (README "Tracing & timeline"):
+    one row per trace_id — root name, start/end, span count, and whether
+    the root span has landed (`complete`). Arm the plane with RT_TRACING=1
+    (+ RT_TRACE_SAMPLE for head-based sampling); export any row with
+    `ray-tpu timeline --trace <id>` or `get_trace()`."""
+    return _call("list_traces", limit=limit)["traces"]
+
+
+def get_trace(trace_id: str) -> dict:
+    """Full span list of one trace (unique id prefixes accepted). Falls
+    back to the storage plane for traces evicted from the controller ring.
+    Returns {found, trace_id, name, start, end, complete, spans}."""
+    return _call("get_trace", trace_id=trace_id)
+
+
 def metrics() -> list[dict]:
     """Aggregated application metrics (ray_tpu.util.metrics Counter/Gauge/
     Histogram series, reference `ray metrics` / Prometheus export)."""

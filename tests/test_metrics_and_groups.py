@@ -61,6 +61,72 @@ def test_metrics_from_remote_worker(ray_start_2cpu):
               what="worker metric aggregated")
 
 
+def test_metrics_tail_flushed_on_shutdown(shutdown_only):
+    """Counters minted right before ray_tpu.shutdown() must reach the
+    controller: Worker.disconnect force-flushes the final pending batch and
+    fences it with an acked ping — a short-lived driver no longer loses its
+    last second of metrics (and trailing tracing spans) to the flusher's
+    shutdown guard."""
+    ray_tpu.init(num_cpus=1)
+    c = Counter("rt_test_tail_total", description="tail", tag_keys=())
+    c.inc(5)
+    ctrl = ray_tpu._head.controller  # survives shutdown as a Python object
+    ray_tpu.shutdown()
+    vals = [m["value"] for m in ctrl.metrics.values()
+            if m["name"] == "rt_test_tail_total"]
+    assert vals == [5.0], (
+        f"final metrics batch dropped on shutdown: {vals}")
+
+
+def test_histogram_boundaries_registered_once(monkeypatch):
+    """Bucket boundaries ride ONE histogram_decl record per (name,
+    boundaries) per session; observe records carry values only — at
+    hot-path observation rates (tracing's RPC-frame / decode-step
+    histograms) shipping the boundary list per record bloated every flush
+    batch."""
+    from ray_tpu.util import metrics as m
+
+    captured = []
+    monkeypatch.setattr(m, "_record", captured.append)
+    m._hist_declared.discard(("rt_test_decl_ms", (1.0, 10.0)))
+    h = Histogram("rt_test_decl_ms", boundaries=[1, 10], tag_keys=())
+    for v in (0.5, 5.0, 50.0):
+        h.observe(v)
+    decls = [r for r in captured if r["kind"] == "histogram_decl"]
+    obs = [r for r in captured if r["kind"] == "histogram"]
+    assert len(decls) == 1 and decls[0]["boundaries"] == [1.0, 10.0]
+    assert len(obs) == 3
+    assert all("boundaries" not in r for r in obs)
+    # A second instance with the SAME (name, boundaries) re-declares
+    # nothing; different boundaries do get their own decl.
+    Histogram("rt_test_decl_ms", boundaries=[1, 10], tag_keys=()).observe(2)
+    assert len([r for r in captured if r["kind"] == "histogram_decl"]) == 1
+    Histogram("rt_test_decl_ms", boundaries=[1, 10, 100],
+              tag_keys=()).observe(2)
+    assert len([r for r in captured if r["kind"] == "histogram_decl"]) == 2
+
+
+def test_histogram_decl_aggregates_controller_side(ray_start_2cpu):
+    """End to end: decl-once histograms still bucket correctly at the
+    controller (the roundtrip test above covers the single-record shape;
+    this pins the registry path)."""
+    from ray_tpu.util import metrics as m
+
+    m._hist_declared.discard(("rt_test_e2e_ms", (1.0, 10.0, 100.0)))
+    h = Histogram("rt_test_e2e_ms", boundaries=[1, 10, 100], tag_keys=())
+    for v in (0.5, 5, 50, 500):
+        h.observe(v)
+
+    def _find():
+        return [x for x in state.metrics() if x["name"] == "rt_test_e2e_ms"]
+
+    _wait_for(lambda: _find() and _find()[0]["count"] == 4,
+              what="decl-once histogram aggregated")
+    (hist,) = _find()
+    assert hist["buckets"] == [1, 1, 1, 1]
+    assert hist["boundaries"] == [1.0, 10.0, 100.0]
+
+
 def test_concurrency_groups_parallelism(ray_start_2cpu):
     """Two calls in a group with limit 2 overlap; the default group (limit 1)
     stays serial and is NOT blocked by a saturated other group."""

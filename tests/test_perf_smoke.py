@@ -62,3 +62,17 @@ def test_bench_smoke_runs():
     assert async_s < sync_s, (
         f"async step time ({async_s}s) does not beat sync save "
         f"({sync_s}s) — commit latency is not hidden")
+    # Tracing plane A/B (README "Tracing & timeline"): RT_TRACING unset
+    # must cost nothing (within run-to-run noise of the main run's rate),
+    # and sampled-on (RT_TRACE_SAMPLE=0.01) must stay under 5% overhead.
+    t_off = rep["details"].get("tracing_off_tasks_s")
+    t_on = rep["details"].get("tracing_on_tasks_s")
+    assert t_off and t_on, (
+        "tracing_overhead A/B missing (bench skipped it: see its stderr)")
+    main_rate = rep["details"]["single_client_tasks_async"]
+    assert t_off > 0.75 * main_rate, (
+        f"tracing-off path ({t_off}/s) regressed vs the baseline run "
+        f"({main_rate}/s) — the off path is supposed to be free")
+    assert t_on > t_off / 1.05, (
+        f"sampled-on tracing costs {t_off / t_on:.3f}x "
+        f"(off {t_off}/s vs on {t_on}/s) — budget is 1.05x")

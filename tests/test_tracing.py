@@ -1,0 +1,587 @@
+"""Distributed tracing plane: causal spans from submit to decode.
+
+Covers the README "Tracing & timeline" contract: byte-identical off
+(RT_TRACING unset changes no wire arity, writes no contextvar, arms no
+hook), causal parent/child linkage across nested task submits, trace
+continuity across the direct->controller lease failover (exactly one
+execute span per attempt) and @remote(timeout_s=) retries (attempts chain
+under one trace), the `ray-tpu timeline` Perfetto/catapult export shape,
+and the serve acceptance criterion: a traced streaming request's spans
+account for >= 90% of end-to-end wall time with per-decode-iteration
+host-sync spans individually visible.
+
+reference tests: python/ray/tests/test_tracing.py (trace context
+propagation through tasks/actors) + test_state_api timeline coverage.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import rpc
+
+
+def _wait(pred, timeout: float, what: str):
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            last = pred()
+            if last:
+                return last
+        except Exception:
+            pass
+        time.sleep(0.2)
+    raise TimeoutError(f"timed out waiting for {what}")
+
+
+def _all_spans():
+    from ray_tpu.util import state
+
+    spans = []
+    for row in state.list_traces(limit=100_000):
+        spans.extend(state.get_trace(row["trace_id"])["spans"])
+    return spans
+
+
+# ------------------------------------------------------------ off = free
+def test_tracing_off_is_byte_identical(shutdown_only):
+    """RT_TRACING unset: no hook, no context, and every wire format keeps
+    its pre-tracing arity (old peers/snapshots decode new bytes and vice
+    versa)."""
+    assert not os.environ.get("RT_TRACING")
+    ray_tpu.init(num_cpus=1)
+    from ray_tpu._private import tracing
+    from ray_tpu._private.task_spec import TaskSpec
+
+    assert tracing.enabled() is False
+    assert rpc._TRACE is None  # frame hook disarmed
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    assert ray_tpu.get(f.remote(), timeout=60) == 1
+    assert tracing.current() is None  # no contextvar writes happened
+
+    spec = TaskSpec(task_id="ab" * 8, kind="normal", name="x",
+                    function_id="fn:1")
+    assert spec.trace is None
+    assert len(spec.__getstate__()) == 26   # pre-tracing state arity
+    assert len(spec.task_call_tuple()) == 11
+    acall = TaskSpec.for_actor_call("ab" * 8, "m", [], {}, 1, "x",
+                                    "o" * 32, None, "a" * 32)
+    assert len(acall.actor_call_tuple()) == 7
+    import pickle
+
+    rt = pickle.loads(pickle.dumps(spec))
+    assert rt.trace is None and rt.task_id == spec.task_id
+
+    from ray_tpu.util import state
+
+    assert state.list_traces() == []  # nothing was recorded anywhere
+
+
+def test_traced_wire_tuples_round_trip():
+    """Sampled specs grow the wire tuples by one trailing trace field;
+    both arities decode (back-compat branches)."""
+    from ray_tpu._private.task_spec import TaskSpec, actor_call_spec
+
+    tr = ("t" * 32, "s" * 16)
+    spec = TaskSpec(task_id="ab" * 8, kind="normal", name="x",
+                    function_id="fn:1", trace=tr)
+    assert len(spec.__getstate__()) == 27
+    call = spec.task_call_tuple()
+    assert len(call) == 12
+    back = TaskSpec.for_normal_call(call, "o" * 32, None, {})
+    assert back.trace == tr
+    # Traceless (old-arity) records still decode.
+    spec.trace = None
+    back2 = TaskSpec.for_normal_call(spec.task_call_tuple(), "o" * 32,
+                                     None, {})
+    assert back2.trace is None
+    spec.trace = tr
+    a = TaskSpec.for_actor_call("ab" * 8, "m", [], {}, 1, "x", "o" * 32,
+                                None, "a" * 32, trace=tr)
+    acall = a.actor_call_tuple()
+    assert len(acall) == 8
+    assert actor_call_spec(acall, "o" * 32, None, "a" * 32).trace == tr
+    assert actor_call_spec(acall[:7], "o" * 32, None, "a" * 32).trace is None
+
+
+# -------------------------------------------------------- causal linkage
+def test_nested_submit_spans_chain_causally(monkeypatch, shutdown_only):
+    """driver submit -> parent execute -> child submit -> child execute all
+    share one trace_id with correct parentage; dispatch/result spans land."""
+    monkeypatch.setenv("RT_TRACING", "1")
+    ray_tpu.init(num_cpus=2)
+
+    @ray_tpu.remote
+    def child_task(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def parent_task(x):
+        return ray_tpu.get(child_task.remote(x), timeout=60) + 1
+
+    assert ray_tpu.get(parent_task.remote(1), timeout=60) == 3
+
+    def _linked():
+        spans = _all_spans()
+        p_sub = [s for s in spans if s["k"] == "submit"
+                 and s["n"] == "parent_task"]
+        p_exe = [s for s in spans if s["k"] == "execute"
+                 and s["n"] == "parent_task"]
+        c_sub = [s for s in spans if s["k"] == "submit"
+                 and s["n"] == "child_task"]
+        c_exe = [s for s in spans if s["k"] == "execute"
+                 and s["n"] == "child_task"]
+        if not (p_sub and p_exe and c_sub and c_exe):
+            return None
+        ps, pe, cs, ce = p_sub[0], p_exe[0], c_sub[0], c_exe[0]
+        assert ps["p"] is None, "driver submit is the trace root"
+        assert pe["t"] == ps["t"] and pe["p"] == ps["s"]
+        # The child's submit happened INSIDE the parent's execute span.
+        assert cs["t"] == ps["t"] and cs["p"] == pe["s"]
+        assert ce["t"] == ps["t"] and ce["p"] == cs["s"]
+        # Dispatch + result spans ride the same trace.
+        kinds = {s["k"] for s in spans if s["t"] == ps["t"]}
+        assert "dispatch" in kinds and "result" in kinds
+        return True
+
+    _wait(_linked, 30, "causally linked nested-task spans")
+
+
+def test_actor_call_spans(monkeypatch, shutdown_only):
+    monkeypatch.setenv("RT_TRACING", "1")
+    ray_tpu.init(num_cpus=2)
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self):
+            self.v = 0
+
+        def bump(self):
+            self.v += 1
+            return self.v
+
+    c = Counter.remote()
+    assert ray_tpu.get(c.bump.remote(), timeout=60) == 1
+
+    def _spans():
+        spans = _all_spans()
+        sub = [s for s in spans if s["k"] == "submit" and s["n"] == "bump"]
+        exe = [s for s in spans if s["k"] == "execute" and s["n"] == "bump"]
+        if not (sub and exe):
+            return None
+        assert exe[0]["t"] == sub[0]["t"] and exe[0]["p"] == sub[0]["s"]
+        return True
+
+    _wait(_spans, 30, "actor call spans")
+
+
+# ------------------------------------------------ continuity across retry
+def test_timeout_retry_chains_attempts_in_one_trace(monkeypatch,
+                                                    shutdown_only,
+                                                    tmp_path):
+    """@remote(timeout_s=) attempt 0 is killed by its deadline and retried:
+    both attempts' execute spans chain under the SAME submit span of the
+    same trace — no orphan or duplicate spans."""
+    monkeypatch.setenv("RT_TRACING", "1")
+    ray_tpu.init(num_cpus=1)
+    marker = str(tmp_path / "attempt0")
+
+    @ray_tpu.remote(timeout_s=0.5, max_retries=1)
+    def flaky(path):
+        import os as _os
+        import time as _t
+
+        if not _os.path.exists(path):
+            open(path, "w").close()
+            _t.sleep(30)  # attempt 0: wedge past the deadline
+        return "ok"
+
+    assert ray_tpu.get(flaky.remote(marker), timeout=120) == "ok"
+
+    def _chained():
+        spans = _all_spans()
+        subs = [s for s in spans if s["k"] == "submit" and s["n"] == "flaky"]
+        exes = [s for s in spans if s["k"] == "execute" and s["n"] == "flaky"]
+        if len(exes) < 2:
+            return None
+        assert len(subs) == 1, f"duplicate submit spans: {subs}"
+        assert len(exes) == 2, f"expected one execute span per attempt: {exes}"
+        sub = subs[0]
+        attempts = sorted((e.get("at") or {}).get("attempt") for e in exes)
+        assert attempts == [0, 1]
+        for e in exes:
+            assert e["t"] == sub["t"] and e["p"] == sub["s"]
+        oks = {(e.get("at") or {}).get("attempt"):
+               (e.get("at") or {}).get("ok") for e in exes}
+        assert oks[0] is False and oks[1] is True
+        return True
+
+    _wait(_chained, 40, "timeout-retry attempts chained in one trace")
+
+
+# ------------------------------------------- continuity across failover
+def _spawn_agent(controller_addr: str, session: str, num_cpus=2):
+    env = dict(os.environ)
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    driver_paths = [p for p in sys.path if p and os.path.exists(p)]
+    env["PYTHONPATH"] = os.pathsep.join([pkg_root] + driver_paths)
+    from ray_tpu._private.ids import NodeID
+    from ray_tpu._private.resources import ResourceSet
+
+    node_id = NodeID.from_random().hex()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ray_tpu._private.node_agent",
+         "--controller", controller_addr,
+         "--node-id", node_id,
+         "--session", session,
+         "--resources",
+         json.dumps(ResourceSet({"CPU": float(num_cpus)}).raw())],
+        env=env)
+    return node_id, proc
+
+
+def test_lease_failover_keeps_one_execute_span_per_attempt(monkeypatch):
+    """Sever every owner->worker lease connection mid-batch (the PR 6
+    failover + dedup-replay path): every ref still resolves, and the trace
+    plane shows EXACTLY one execute span per task, chained to that task's
+    submit span — the failover re-route neither loses nor duplicates
+    spans."""
+    monkeypatch.setenv("RT_TRACING", "1")
+    procs = []
+    try:
+        ray_tpu.init(num_cpus=0, _system_config={"fault_injection": True})
+        head = ray_tpu._head
+        addr = f"{head.controller_addr[0]}:{head.controller_addr[1]}"
+        nid, proc = _spawn_agent(addr, head.session_id, num_cpus=2)
+        procs.append(proc)
+
+        def _snapshot():
+            return ray_tpu._private.worker.global_worker().state_snapshot()
+
+        _wait(lambda: (_snapshot()["nodes"].get(nid) or {}).get("alive"),
+              60, "node to register")
+
+        marker_dir = tempfile.mkdtemp(prefix="rt_trace_fo_")
+        log = os.path.join(marker_dir, "executions.log")
+
+        @ray_tpu.remote(num_cpus=1, max_retries=0)
+        def tracked(i, path):
+            import os as _os
+            import time as _t
+
+            fd = _os.open(path, _os.O_WRONLY | _os.O_CREAT | _os.O_APPEND,
+                          0o644)
+            _os.write(fd, f"{i}\n".encode())
+            _os.close(fd)
+            _t.sleep(0.15)
+            return i
+
+        ray_tpu.get([tracked.remote(-1 - j, log) for j in range(2)],
+                    timeout=60)
+        n = 8
+        refs = [tracked.remote(i, log) for i in range(n)]
+        task_ids = [r.task_id() for r in refs]
+
+        def _started():
+            try:
+                with open(log) as f:
+                    return sum(1 for ln in f if not ln.startswith("-")) >= 2
+            except OSError:
+                return False
+
+        _wait(_started, 30, "batch to start executing")
+        inj = rpc.fault_injector()
+        assert inj.sever("lease") >= 1, "no lease connections to sever"
+        assert ray_tpu.get(refs, timeout=120) == list(range(n))
+
+        def _one_exec_each():
+            spans = _all_spans()
+            by_task: dict = {}
+            subs: dict = {}
+            for s in spans:
+                t = (s.get("at") or {}).get("task")
+                if t is None:
+                    continue
+                if s["k"] == "execute":
+                    by_task.setdefault(t, []).append(s)
+                elif s["k"] == "submit":
+                    subs[t] = s
+            if not all(tid in by_task for tid in task_ids):
+                return None
+            for tid in task_ids:
+                exes = by_task[tid]
+                assert len(exes) == 1, (
+                    f"task {tid[:12]} has {len(exes)} execute spans "
+                    f"(failover duplicated or lost the execution)")
+                sub = subs.get(tid)
+                assert sub is not None
+                assert exes[0]["t"] == sub["t"]
+                assert exes[0]["p"] == sub["s"]
+            return True
+
+        _wait(_one_exec_each, 40,
+              "exactly one execute span per task after failover")
+    finally:
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        for proc in procs:
+            try:
+                proc.kill()
+            except Exception:
+                pass
+        inj = rpc.fault_injector()
+        if inj is not None:
+            inj.clear()
+        rpc.disable_fault_injection()
+
+
+# ---------------------------------------------------- timeline export
+def test_timeline_cli_exports_perfetto_json(monkeypatch, shutdown_only,
+                                            tmp_path):
+    """`ray-tpu timeline -o` emits catapult-shaped JSON Perfetto accepts:
+    a traceEvents list of complete "X" events (plus "M" metadata) with
+    numeric, monotonically non-decreasing timestamps."""
+    monkeypatch.setenv("RT_TRACING", "1")
+    ray_tpu.init(num_cpus=1)
+
+    @ray_tpu.remote
+    def traced_fn(x):
+        return x * 2
+
+    assert ray_tpu.get(traced_fn.remote(21), timeout=60) == 42
+    from ray_tpu.util import state
+
+    _wait(lambda: any(r["spans"] for r in state.list_traces()),
+          30, "traces indexed controller-side")
+
+    head = ray_tpu._head
+    addr = f"{head.controller_addr[0]}:{head.controller_addr[1]}"
+    out = str(tmp_path / "trace.json")
+    from ray_tpu.scripts.cli import main as cli_main
+
+    assert cli_main(["timeline", "--address", addr, "-o", out]) == 0
+    doc = json.load(open(out))
+    evs = doc["traceEvents"]
+    assert isinstance(evs, list) and evs
+    assert doc.get("displayTimeUnit") == "ms"
+    last_ts = -1.0
+    seen_x = 0
+    for e in evs:
+        assert e["ph"] in ("X", "M"), f"unexpected event phase: {e}"
+        assert isinstance(e["pid"], int)
+        if e["ph"] == "X":
+            seen_x += 1
+            assert isinstance(e["ts"], (int, float))
+            assert isinstance(e["dur"], (int, float)) and e["dur"] >= 1.0
+            assert e["ts"] >= last_ts, "timestamps must be monotonic"
+            last_ts = e["ts"]
+            assert e["name"] and "cat" in e and "tid" in e
+    assert seen_x >= 3  # at least submit/dispatch-or-result/execute
+
+    # --trace with a unique prefix selects one trace.
+    rows = state.list_traces()
+    tid = rows[-1]["trace_id"]
+    out2 = str(tmp_path / "one.json")
+    assert cli_main(["timeline", "--address", addr, "--trace", tid[:12],
+                     "-o", out2]) == 0
+    doc2 = json.load(open(out2))
+    assert all((e["args"].get("trace_id") == tid)
+               for e in doc2["traceEvents"] if e["ph"] == "X")
+
+
+def test_trace_persisted_through_storage_plane(monkeypatch, shutdown_only):
+    """Completed traces land under <session>/traces/ via the PR 8 storage
+    backend and stay readable through get_trace after controller eviction
+    (simulated by reading the file directly)."""
+    monkeypatch.setenv("RT_TRACING", "1")
+    ray_tpu.init(num_cpus=1)
+
+    @ray_tpu.remote
+    def f():
+        return 1
+
+    assert ray_tpu.get(f.remote(), timeout=60) == 1
+    from ray_tpu.util import state
+
+    rows = _wait(lambda: [r for r in state.list_traces() if r["complete"]],
+                 30, "a completed trace")
+    tid = rows[0]["trace_id"]
+    head = ray_tpu._head
+    from ray_tpu._private.rtconfig import CONFIG
+
+    tdir = os.path.join(CONFIG.session_dir, head.session_id, "traces")
+    path = os.path.join(tdir, f"{tid}.json")
+    _wait(lambda: os.path.exists(path), 30, "trace persisted to storage")
+    doc = json.load(open(path))
+    assert doc["trace_id"] == tid and doc["spans"]
+
+
+# ---------------------------------------------- serve acceptance criterion
+def test_serve_streaming_trace_accounts_request_wall_time(monkeypatch,
+                                                          shutdown_only):
+    """ISSUE 11 acceptance: on a traced serve streaming-generation request,
+    the exported spans account for >= 90% of end-to-end request wall time,
+    and per-decode-iteration engine.host_sync spans make the host-link
+    round trips individually visible."""
+    monkeypatch.setenv("RT_TRACING", "1")
+    ray_tpu.init(num_cpus=4)
+    from ray_tpu import serve
+    from ray_tpu.llm import LLMConfig
+    from ray_tpu.llm.openai import build_openai_app
+
+    import socket
+    import urllib.request
+
+    cfg = LLMConfig(vocab_size=384, d_model=64, n_layers=2, n_heads=4,
+                    max_seq=128)
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    app = build_openai_app(cfg, model_id="traced-llm", max_batch=4,
+                           decode_chunk=4, default_max_tokens=24)
+    serve.run(app, route_prefix="/", port=port)
+    try:
+        body = json.dumps({"prompt": "hello tracer", "max_tokens": 24,
+                           "temperature": 0.0, "stream": True}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/completions", data=body,
+            headers={"Content-Type": "application/json"})
+        ntok = 0
+        with urllib.request.urlopen(req, timeout=180) as r:
+            for line in r:
+                line = line.decode().strip()
+                if line.startswith("data: ") and line != "data: [DONE]":
+                    ntok += 1
+        assert ntok >= 24
+
+        from ray_tpu.util import state
+
+        def _request_trace():
+            for row in state.list_traces(limit=1000):
+                if not row["complete"]:
+                    continue
+                if not str(row.get("name") or "").startswith("http POST"):
+                    continue
+                doc = state.get_trace(row["trace_id"])
+                spans = doc["spans"]
+                if (any(s["n"] == "engine.host_sync" for s in spans)
+                        and any(s["k"] == "execute" for s in spans)
+                        and any(s["p"] is None for s in spans)):
+                    return doc
+            return None
+
+        doc = _wait(_request_trace, 40, "request trace with engine spans")
+        spans = doc["spans"]
+        root = next(s for s in spans if s["p"] is None)
+        wall = root["b"] - root["a"]
+        assert wall > 0
+        # Union of child-span coverage clipped to the root window.
+        ivs = sorted(
+            (max(s["a"], root["a"]), min(s["b"], root["b"]))
+            for s in spans if s is not root and s["b"] > s["a"])
+        covered, cur = 0.0, None
+        for a, b in ivs:
+            if b <= a:
+                continue
+            if cur is None:
+                cur = [a, b]
+            elif a <= cur[1]:
+                cur[1] = max(cur[1], b)
+            else:
+                covered += cur[1] - cur[0]
+                cur = [a, b]
+        if cur is not None:
+            covered += cur[1] - cur[0]
+        assert covered >= 0.9 * wall, (
+            f"spans cover only {covered / wall:.1%} of the request's "
+            f"{wall * 1e3:.0f}ms wall time")
+        # Per-decode-iteration host syncs: the BENCH_r05 host-link cost,
+        # individually visible (>= 2 iterations for 24 tokens at chunk 4 /
+        # depth 4).
+        syncs = [s for s in spans if s["n"] == "engine.host_sync"]
+        assert len(syncs) >= 2, f"host syncs not per-iteration: {syncs}"
+        assert any(s["n"] == "engine.dispatch_chunk" for s in spans)
+        assert any(s["n"] == "engine.prefill" for s in spans)
+    finally:
+        serve.shutdown()
+
+
+# ---------------------------------------------------------- stall linkage
+def test_stall_report_carries_trace_id(monkeypatch, shutdown_only):
+    """A stalled TRACED task's StallReport names its trace id, linking
+    `ray-tpu stalls` output to `ray-tpu timeline --trace`."""
+    monkeypatch.setenv("RT_TRACING", "1")
+    monkeypatch.setenv("RT_STALL_WARN_S", "0.6")
+    monkeypatch.setenv("RT_STALL_BEACON_INTERVAL_S", "0.1")
+    ray_tpu.init(num_cpus=1)
+
+    @ray_tpu.remote
+    def spinner():
+        import time as _t
+
+        _t.sleep(2.5)  # no progress reports: crosses the warn threshold
+        return "done"
+
+    ref = spinner.remote()
+    from ray_tpu.util import state
+
+    def _stall_with_trace():
+        rows = [r for r in state.list_stalls()
+                if r.get("stage") == "warn" and r.get("trace_id")]
+        return rows or None
+
+    rows = _wait(_stall_with_trace, 30, "stall report carrying a trace id")
+    assert ray_tpu.get(ref, timeout=60) == "done"
+    tid = rows[0]["trace_id"]
+
+    def _trace_known():
+        return any(r["trace_id"] == tid for r in state.list_traces())
+
+    _wait(_trace_known, 30, "the stalled task's trace to be indexed")
+
+
+def test_unsampled_stall_escalates_to_trace_root(monkeypatch, shutdown_only):
+    """Always-sample escalation: a stalled task whose root was NOT sampled
+    (RT_TRACE_SAMPLE=0) still gets a minted trace root, and the stall
+    report names it."""
+    monkeypatch.setenv("RT_TRACING", "1")
+    monkeypatch.setenv("RT_TRACE_SAMPLE", "0")
+    monkeypatch.setenv("RT_STALL_WARN_S", "0.6")
+    monkeypatch.setenv("RT_STALL_BEACON_INTERVAL_S", "0.1")
+    ray_tpu.init(num_cpus=1)
+
+    @ray_tpu.remote
+    def spinner2():
+        import time as _t
+
+        _t.sleep(2.5)
+        return "done"
+
+    ref = spinner2.remote()
+    from ray_tpu.util import state
+
+    rows = _wait(lambda: [r for r in state.list_stalls()
+                          if r.get("name") == "spinner2"
+                          and r.get("trace_id")],
+                 30, "unsampled stall report carrying an escalation trace")
+    assert ray_tpu.get(ref, timeout=60) == "done"
+    tid = rows[0]["trace_id"]
+    doc = _wait(lambda: (state.get_trace(tid)
+                         if state.get_trace(tid).get("found") else None),
+                30, "the escalation trace root to be indexed")
+    roots = [s for s in doc["spans"] if s["p"] is None]
+    assert roots and (roots[0].get("at") or {}).get("stalled") is True
+    assert (roots[0].get("at") or {}).get("sampled") is False
