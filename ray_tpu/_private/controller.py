@@ -18,6 +18,7 @@ for small objects (reference CoreWorkerMemoryStore memory_store.h:45).
 from __future__ import annotations
 
 import asyncio
+import bisect
 import logging
 import os
 import time
@@ -96,6 +97,60 @@ class _ActorEntry:
             if not fut.done():
                 fut.set_result(None)
         self.waiters.clear()
+
+
+#: Decimation factor for the telemetry ring: every DECIM raw points aging
+#: out of the recent tier fold into ONE averaged history point.
+_TELEM_DECIM = 8
+
+#: Controller self-telemetry: per-RPC-method latency bucket boundaries
+#: (seconds). Matches rt_rpc_frame_seconds' spirit but tuned to handler
+#: execution times; shared by every method's histogram.
+_RPC_BOUNDS = [0.0002, 0.001, 0.005, 0.02, 0.1, 0.5, 2.0]
+
+
+class _SeriesRing:
+    """Bounded two-tier timeseries for one (node, series[, worker]): a raw
+    recent deque plus a decimated history deque (mean of every
+    _TELEM_DECIM points aging out of raw). Memory is O(2 * points) per
+    series regardless of runtime; timestamps stay monotone because append
+    rejects out-of-order points."""
+
+    __slots__ = ("raw", "hist", "acc_sum", "acc_n", "last_ts")
+
+    def __init__(self, points: int):
+        self.raw: deque = deque()
+        self.hist: deque = deque(maxlen=points)
+        self.acc_sum = 0.0
+        self.acc_n = 0
+        self.last_ts = 0.0
+
+    def append(self, ts: float, val: float, points: int) -> None:
+        if ts <= self.last_ts:
+            return  # late/duplicate batch: keep the series monotone
+        while len(self.raw) >= max(2, points):
+            old_ts, old_val = self.raw.popleft()
+            self.acc_sum += old_val
+            self.acc_n += 1
+            if self.acc_n >= _TELEM_DECIM:
+                self.hist.append((old_ts, self.acc_sum / self.acc_n))
+                self.acc_sum = 0.0
+                self.acc_n = 0
+        self.raw.append((ts, float(val)))
+        self.last_ts = ts
+
+    def points(self, since: float | None = None) -> list:
+        out = [list(p) for p in self.hist] + [list(p) for p in self.raw]
+        if since is not None:
+            out = [p for p in out if p[0] > since]
+        return out
+
+    def latest(self) -> tuple | None:
+        if self.raw:
+            return self.raw[-1]
+        if self.hist:
+            return self.hist[-1]
+        return None
 
 
 class Controller:
@@ -220,6 +275,22 @@ class Controller:
         # per-task beacon ages riding agent heartbeats, so task_status can
         # answer "how long has the producer been silent".
         self._task_beacons: dict[str, tuple] = {}
+        # Telemetry plane (README "Telemetry & profiling"): (node_id,
+        # series, worker_prefix) -> _SeriesRing, fed by the `telemetry`
+        # batches riding agent heartbeats plus the controller's own
+        # self-sample tick. Series quiet past RT_TELEMETRY_WINDOW_S age
+        # out (a dead agent's series disappear instead of freezing).
+        self.telemetry: dict[tuple, _SeriesRing] = {}
+        self._telem_prune_at = 0.0
+        self._telem_skew: dict[str, float] = {}  # node -> sticky rebase
+        self._telem_task: Optional[asyncio.Task] = None
+        # Controller self-telemetry, no agent involved: per-RPC-method
+        # latency/count histograms (method -> [count, sum, buckets]) —
+        # accumulated inline in _on_request (two perf_counter reads + one
+        # bisect; always on) — and the event-loop lag gauge (measured by
+        # the self-sample tick, None while telemetry is unarmed).
+        self._rpc_stats: dict[str, list] = {}
+        self._loop_lag: Optional[float] = None
         # node_id -> latest minted incarnation. Survives the NodeState
         # (incremented across SUSPECT->DEAD->rejoin), so a zombie agent
         # from ANY previous life is fenced, not just the last one.
@@ -237,6 +308,11 @@ class Controller:
         self.port = await self.server.start(host, port)
         self._tasks.append(asyncio.ensure_future(self._schedule_loop()))
         self._tasks.append(asyncio.ensure_future(self._health_loop()))
+        from ray_tpu._private import telemetry as _telemetry
+
+        if _telemetry.interval_s() > 0:
+            self._telem_task = asyncio.ensure_future(self._self_sample_loop())
+            self._tasks.append(self._telem_task)
         return self.port
 
     async def _reconcile_recovering(self):
@@ -441,7 +517,23 @@ class Controller:
         handler = getattr(self, f"_h_{method}", None)
         if handler is None:
             raise rpc.RpcError(f"controller: unknown method {method}")
-        return await handler(conn, a)
+        # Controller self-telemetry: per-method handler latency histogram
+        # (README "Telemetry & profiling" — the direct input to the
+        # control-plane scale harness, ROADMAP item 3). Always on: two
+        # perf_counter reads + a bisect over 7 bounds per request, cheap
+        # against any handler body; exposed via /metrics and get_metrics.
+        t0 = time.perf_counter()
+        try:
+            return await handler(conn, a)
+        finally:
+            dt = time.perf_counter() - t0
+            st = self._rpc_stats.get(method)
+            if st is None:
+                st = self._rpc_stats[method] = [
+                    0, 0.0, [0] * (len(_RPC_BOUNDS) + 1)]
+            st[0] += 1
+            st[1] += dt
+            st[2][bisect.bisect_left(_RPC_BOUNDS, dt)] += 1
 
     async def _on_push(self, conn: rpc.Connection, method: str, a: dict):
         handler = getattr(self, f"_p_{method}", None)
@@ -767,6 +859,9 @@ class Controller:
                 self._task_beacons[a["node_id"]] = (beacons, time.monotonic())
             else:
                 self._task_beacons.pop(a.get("node_id"), None)
+            telem = a.get("telemetry")
+            if telem:
+                self._ingest_telemetry(a["node_id"], telem)
 
     # ---------------------------------------------------------- scheduling
     def _kick(self):
@@ -1797,7 +1892,361 @@ class Controller:
             self._ingest_spans(spans)
 
     async def _h_get_metrics(self, conn, a):
-        return {"metrics": list(self.metrics.values())}
+        # Aggregated application series PLUS the controller's
+        # self-telemetry, synthesized at scrape time (no tick needed):
+        # per-RPC-method latency histograms, table-size gauges, and — when
+        # the sampling plane is armed — the event-loop lag gauge. All of
+        # it flows into the dashboard's /metrics Prometheus exposition.
+        out = list(self.metrics.values())
+        for method, (n, s, buckets) in sorted(self._rpc_stats.items()):
+            out.append({
+                "name": "rt_controller_rpc_seconds", "kind": "histogram",
+                "desc": "controller RPC handler latency by method",
+                "tags": {"method": method}, "value": 0.0, "count": n,
+                "sum": round(s, 6), "boundaries": list(_RPC_BOUNDS),
+                "buckets": list(buckets)})
+        for table, size in self._table_sizes().items():
+            out.append({
+                "name": "rt_controller_table_size", "kind": "gauge",
+                "desc": "controller state-table row counts",
+                "tags": {"table": table}, "value": float(size),
+                "count": 0, "sum": 0.0, "buckets": None})
+        if self._loop_lag is not None:
+            out.append({
+                "name": "rt_controller_loop_lag_seconds", "kind": "gauge",
+                "desc": "controller event-loop scheduling lag",
+                "tags": {}, "value": float(self._loop_lag),
+                "count": 0, "sum": 0.0, "buckets": None})
+        return {"metrics": out}
+
+    # ------------------------------------------------------ telemetry plane
+    def _table_sizes(self) -> dict:
+        """Row counts of the controller's hot tables — the direct input to
+        ROADMAP item 3's control-plane scale work (which tables grow is
+        which tables shard first)."""
+        return {
+            "objects": len(self.objects),
+            "actors": len(self.actors),
+            "leases": len(self.leases),
+            "parked_grants": self._lease_waiters,
+            "pending_tasks": len(self.pending),
+            "dispatched_tasks": len(self.dispatched),
+            "nodes": len(self.nodes),
+            "clients": len(self.client_conns),
+            "kv": len(self.kv),
+            "traces": len(self.traces),
+        }
+
+    def _telem_append(self, key: tuple, ts: float, val) -> None:
+        if not isinstance(val, (int, float)):
+            return
+        points = max(16, int(CONFIG.telemetry_points))
+        ring = self.telemetry.get(key)
+        if ring is None:
+            ring = self.telemetry[key] = _SeriesRing(points)
+        ring.append(ts, val, points)
+
+    #: Agent wall clocks further than this from the controller's are
+    #: rebased at ingest: window pruning, since= filtering, and sample_age
+    #: all compare against the CONTROLLER clock, and an unsynced node
+    #: would otherwise have its series pruned on arrival (clock behind) or
+    #: kept past age-out (clock ahead). Small skew passes through — the
+    #: 600s window and 120s sparkline dwarf it.
+    _TELEM_SKEW_REBASE_S = 30.0
+
+    def _ingest_telemetry(self, nid: str, batches: list) -> None:
+        """Fold heartbeat-piggybacked sample batches into the per-(node,
+        series) rings. Worker-scoped series key on a 12-char worker-id
+        prefix (matches every other surface's display ids)."""
+        tss = []
+        for b in batches:
+            try:
+                tss.append(float(b.get("ts") or time.time()))
+            except (TypeError, ValueError):
+                tss.append(None)
+        newest = max((t for t in tss if t is not None), default=None)
+        # Delivery just happened, so the newest batch was sampled within
+        # ~one heartbeat of controller-now: a larger gap is clock skew.
+        # The applied offset is STICKY per node (re-locked only when the
+        # measured skew moves a full threshold away from it): a hard
+        # threshold alone would flip offset on/off for skew hovering near
+        # it, and the ring's monotone guard would then reject alternate
+        # deliveries wholesale.
+        offset = self._telem_skew.get(nid, 0.0)
+        if newest is not None:
+            skew = time.time() - newest
+            if abs(skew - offset) > self._TELEM_SKEW_REBASE_S:
+                offset = skew if abs(skew) > self._TELEM_SKEW_REBASE_S \
+                    else 0.0
+                self._telem_skew[nid] = offset
+        for b, ts in zip(batches, tss):
+            if ts is None:
+                continue
+            ts += offset
+            for series, val in (b.get("node") or {}).items():
+                self._telem_append((nid, f"node.{series}", ""), ts, val)
+            for wid, wseries in (b.get("workers") or {}).items():
+                sub = str(wid)[:12]
+                for series, val in (wseries or {}).items():
+                    self._telem_append((nid, f"worker.{series}", sub),
+                                       ts, val)
+        self._telem_prune()
+
+    def _telem_prune(self) -> None:
+        """Age out series with no fresh point for RT_TELEMETRY_WINDOW_S (a
+        dead agent or reaped worker leaves no stuck series). Rate-limited:
+        one sweep per ~window/8."""
+        window = max(5.0, float(CONFIG.telemetry_window_s))
+        now = time.time()
+        if now < self._telem_prune_at:
+            return
+        self._telem_prune_at = now + max(1.0, window / 8.0)
+        cutoff = now - window
+        for key in [k for k, r in self.telemetry.items()
+                    if r.last_ts < cutoff]:
+            self.telemetry.pop(key, None)
+        live_nodes = {k[0] for k in self.telemetry}
+        for nid in [n for n in self._telem_skew if n not in live_nodes]:
+            self._telem_skew.pop(nid, None)
+
+    def _telem_purge_worker(self, worker_id: str) -> None:
+        """Drop a dead worker's per-worker series immediately: its rings
+        would otherwise keep reporting the last HBM/compile/RSS sample as
+        current via cluster_utilization/`ray-tpu top` until the
+        RT_TELEMETRY_WINDOW_S prune — the freezing-last-values failure
+        mode the node-death path already avoids."""
+        sub = str(worker_id)[:12]
+        for key in [k for k in self.telemetry if k[2] == sub]:
+            self.telemetry.pop(key, None)
+
+    async def _self_sample_loop(self):
+        """Controller self-telemetry tick (armed with the sampling plane):
+        measures event-loop scheduling lag and feeds the controller's own
+        table sizes into the same ring the node series live in, under the
+        reserved node id "controller"."""
+        from ray_tpu._private import telemetry as _telemetry
+
+        interval = max(0.05, _telemetry.interval_s())
+        while not self._stopping:
+            t0 = time.monotonic()
+            await asyncio.sleep(interval)
+            lag = max(0.0, time.monotonic() - t0 - interval)
+            self._loop_lag = round(lag, 6)
+            ts = time.time()
+            self._telem_append(("controller", "ctrl.loop_lag_s", ""),
+                               ts, self._loop_lag)
+            for table, size in self._table_sizes().items():
+                self._telem_append(("controller", f"ctrl.{table}", ""),
+                                   ts, size)
+            self._telem_prune()
+
+    async def _h_timeseries(self, conn, a):
+        """Query the telemetry rings: /api/timeseries?series=&node_id=&since=
+        and `util.state.timeseries()`. `series` matches exactly or as a
+        prefix (`node.` selects the whole family); points are
+        [[ts, value], ...], timestamps strictly monotone per row."""
+        sel = a.get("series") or None
+        nid = a.get("node_id") or None
+        since = a.get("since")
+        since = float(since) if since is not None else None
+        self._telem_prune()
+        rows = []
+        for (knid, series, sub), ring in self.telemetry.items():
+            if nid is not None and knid != nid:
+                continue
+            if sel is not None and series != sel \
+                    and not series.startswith(sel):
+                continue
+            pts = ring.points(since)
+            if not pts:
+                continue
+            rows.append({"node_id": knid, "series": series,
+                         "worker_id": sub or None, "points": pts})
+        rows.sort(key=lambda r: (r["node_id"], r["series"],
+                                 r["worker_id"] or ""))
+        return {"series": rows, "now": time.time(),
+                "interval_s": CONFIG.telemetry_interval_s,
+                "window_s": CONFIG.telemetry_window_s}
+
+    async def _h_cluster_utilization(self, conn, a):
+        """Latest sample per node/worker plus controller self-stats — the
+        one-call backing of `ray-tpu top` and
+        `util.state.cluster_utilization()`."""
+        self._telem_prune()
+        nodes: dict[str, dict] = {}
+        for nid, n in self.nodes.items():
+            nodes[nid] = {
+                "alive": n.alive, "liveness": n.liveness,
+                "beat_age": round(time.monotonic() - n.last_beat, 3),
+                "node": {}, "workers": {},
+            }
+        for (knid, series, sub), ring in self.telemetry.items():
+            last = ring.latest()
+            if last is None or knid == "controller":
+                continue
+            ent = nodes.get(knid)
+            if ent is None:  # series outliving its node entry (death race)
+                continue
+            if sub:
+                ent["workers"].setdefault(sub, {})[
+                    series.split(".", 1)[1]] = last[1]
+            else:
+                ent["node"][series.split(".", 1)[1]] = last[1]
+            age = round(time.time() - ring.last_ts, 3)
+            if "sample_age" not in ent or age < ent["sample_age"]:
+                ent["sample_age"] = age  # freshest series wins
+        return {
+            "nodes": nodes,
+            "controller": {
+                "loop_lag_s": self._loop_lag,
+                "tables": self._table_sizes(),
+                "rpc_total": sum(v[0] for v in self._rpc_stats.values()),
+            },
+            "telemetry_armed": bool(self.telemetry) or
+                self._telem_task is not None,
+            "now": time.time(),
+        }
+
+    # ----------------------------------------------------- worker profiling
+    async def _h_profile_worker(self, conn, a):
+        """Route an on-demand profile capture to the agent hosting the
+        worker (same lookup as worker_stacks), then register the returned
+        metadata in the KV (`_profiles` namespace) so list_profiles rows
+        survive the capture path."""
+        from ray_tpu._private import telemetry as _telemetry
+
+        wid = a.get("worker_id") or ""
+        nid = a.get("node_id")
+        if nid is None:
+            hits = self._find_worker_nodes(wid)
+            if len(hits) > 1:
+                return {"found": False,
+                        "error": f"worker id prefix {wid[:12]!r} is "
+                                 f"ambiguous ({len(hits)} nodes match) — "
+                                 f"use a longer prefix"}
+            nid = next(iter(hits)) if hits else None
+        if nid is None:
+            return {"found": False,
+                    "error": f"worker {wid[:12]} not found in the actor, "
+                             f"lease, or dispatch tables (pass node_id, or "
+                             f"profile while it is running work)"}
+        nconn = self.node_conns.get(nid)
+        if nconn is None or nconn.closed:
+            return {"found": False, "error": f"node {nid[:8]} not connected"}
+        seconds = _telemetry.clamp_profile_seconds(a.get("seconds"))
+        try:
+            rep = await nconn.call(
+                "profile_worker", worker_id=wid, seconds=seconds,
+                mode=a.get("mode") or "cpu", hz=a.get("hz"),
+                _timeout=seconds + 40.0)
+        except Exception as e:
+            # Agent death/sever/timeout mid-capture follows the same
+            # attributed-error contract as every other failure branch
+            # here. A persist that merely outlived the timeout still
+            # registers via the agent's profile_persisted push.
+            return {"found": False,
+                    "error": f"profile via node {nid[:8]} failed "
+                             f"mid-capture ({type(e).__name__}: {e})"}
+        if rep.get("found") and rep.get("profile"):
+            # Idempotent with the agent's profile_persisted push (the
+            # authoritative registration — it lands even when a slow
+            # storage persist outlives this call's timeout budget); kept
+            # here as backup for a push lost to a reconnecting conn.
+            self._register_profile(rep["profile"])
+        return rep
+
+    async def _p_profile_persisted(self, conn, a):
+        """Agent push after a captured profile lands in the storage plane.
+        Registration rides this push rather than only the profile_worker
+        reply so a persist slower than the caller's RPC timeout still
+        indexes the document it wrote (orphaned docs are invisible to
+        list_profiles/get_profile forever)."""
+        meta = a.get("profile")
+        if isinstance(meta, dict) and meta.get("name"):
+            self._register_profile(meta)
+
+    def _register_profile(self, meta: dict) -> None:
+        import json as _json
+
+        self.kv[("_profiles", meta["name"])] = _json.dumps(
+            meta, default=str).encode()
+        # Bounded registry (ring discipline, like traces/stalls):
+        # automated periodic profiling must not grow the KV — and
+        # every controller snapshot — forever. Evicted rows lose only
+        # their index entry; the documents stay in the storage plane.
+        names = sorted(k[1] for k in self.kv
+                       if k[0] == "_profiles")
+        for stale in names[:-self._PROFILE_INDEX_CAP]:
+            self.kv.pop(("_profiles", stale), None)
+        self._mark_dirty()
+
+    _PROFILE_INDEX_CAP = 512  # metadata rows kept (oldest evicted)
+
+    def _find_worker_nodes(self, wid: str) -> set[str]:
+        """Nodes hosting workers matching `wid` (exact id or prefix), from
+        the actor / lease / dispatch tables. One hit routes; zero and
+        many are distinct error cases (missing vs ambiguous prefix)."""
+        hits: set[str] = set()
+        for ent in self.actors.values():
+            if ent.worker_id and ent.worker_id.startswith(wid):
+                hits.add(ent.node_id)
+        for lease in self.leases.values():
+            if str(lease.get("worker_id") or "").startswith(wid):
+                hits.add(lease["node_id"])
+        for info in self.dispatched.values():
+            if str(info.get("worker_id") or "").startswith(wid):
+                hits.add(info["node_id"])
+        hits.discard(None)
+        return hits
+
+    async def _h_list_profiles(self, conn, a):
+        """Captured-profile metadata rows from the KV registry, newest
+        last; same limit/truncation contract as the other list APIs."""
+        import json as _json
+
+        limit = int(a.get("limit", 1000))
+        rows = []
+        for (ns, name), blob in self.kv.items():
+            if ns != "_profiles":
+                continue
+            try:
+                rows.append(_json.loads(blob))
+            except ValueError:
+                continue
+        rows.sort(key=lambda r: r.get("created") or 0)
+        truncated = len(rows) > limit
+        return {"profiles": rows[-limit:], "truncated": truncated}
+
+    async def _h_get_profile(self, conn, a):
+        """Fetch one persisted profile document by name (unique prefixes
+        accepted) from the storage plane."""
+        import json as _json
+
+        name = a.get("name") or ""
+        metas = []
+        for (ns, key), blob in self.kv.items():
+            if ns == "_profiles" and key.startswith(name):
+                metas.append(blob)
+        if len(metas) != 1:
+            return {"found": False, "name": name,
+                    "error": ("no profile matches" if not metas
+                              else "ambiguous prefix")}
+        meta = _json.loads(metas[0])
+
+        def _load(path=meta.get("path")):
+            # Read AND parse off the event loop: a cpu capture's document
+            # (thousands of traceEvents) is easily multi-MB of JSON.
+            from ray_tpu import storage
+
+            return _json.loads(storage.get_bytes(path))
+
+        try:
+            doc = await asyncio.get_running_loop().run_in_executor(
+                None, _load)
+        except Exception as e:
+            return {"found": False, "name": name,
+                    "error": f"profile doc unreadable: {e!r}"}
+        return {"found": True, **doc}
 
     # ------------------------------------------------------- tracing plane
     _TRACE_SPAN_CAP = 8192  # spans kept per trace (ring discipline)
@@ -1915,7 +2364,7 @@ class Controller:
                          "start": ent.get("start"), "end": ent.get("last"),
                          "spans": len(ent["spans"]),
                          "complete": bool(ent.get("root_done"))})
-        return {"traces": rows[-limit:]}
+        return {"traces": rows[-limit:], "truncated": len(rows) > limit}
 
     async def _h_get_trace(self, conn, a):
         """Spans of one trace; unique id prefixes accepted (CLI ergonomics).
@@ -1995,7 +2444,8 @@ class Controller:
 
     async def _h_list_stalls(self, conn, a):
         limit = int(a.get("limit", 1000))
-        return {"stalls": list(self.stalls)[-limit:]}
+        return {"stalls": list(self.stalls)[-limit:],
+                "truncated": len(self.stalls) > limit}
 
     async def _h_task_status(self, conn, a):
         """Best-effort status of ONE task — the enrichment behind
@@ -2063,20 +2513,29 @@ class Controller:
                         "node_id": info["node_id"],
                         "worker_id": info["worker_id"],
                         "start": None, "end": None}
-        return {"tasks": list(out.values())[-limit:]}
+        # Uniform truncation contract (shared by every list API): rows
+        # beyond `limit` drop oldest-first and the reply says so instead
+        # of silently shrinking.
+        return {"tasks": list(out.values())[-limit:],
+                "truncated": len(out) > limit}
 
     async def _h_list_objects(self, conn, a):
+        import itertools
+
         limit = int(a.get("limit", 1000))
-        out = []
-        for oid, ent in self.objects.items():
-            out.append({"object_id": oid, "state": ent.state,
-                        "size": ent.size, "owner": ent.owner,
-                        "inline": ent.inline is not None,
-                        "plane": ent.plane or "host",
-                        "holders": [list(h) for h in ent.holders]})
-            if len(out) >= limit:
-                break
-        return {"objects": out}
+        total = len(self.objects)
+        # Uniform truncation contract: oldest rows drop first (insertion
+        # order), same as every other list API — but only the kept tail
+        # is materialized (an O(table) dict build per call would stall
+        # the event loop exactly when the table is large).
+        out = [{"object_id": oid, "state": ent.state,
+                "size": ent.size, "owner": ent.owner,
+                "inline": ent.inline is not None,
+                "plane": ent.plane or "host",
+                "holders": [list(h) for h in ent.holders]}
+               for oid, ent in itertools.islice(
+                   self.objects.items(), max(0, total - limit), None)]
+        return {"objects": out, "truncated": total > limit}
 
     async def _p_worker_logs(self, conn, a):
         """Fan worker stdout/stderr lines out to subscribed drivers
@@ -2548,6 +3007,7 @@ class Controller:
         wid = worker_id or ent.worker_id
         if wid and not device_swept:
             await self._device_objects_lost(wid, f"died ({reason})")
+            self._telem_purge_worker(wid)
         # Drop any in-flight creation bookkeeping.
         self.dispatched.pop(ent.spec.task_id, None)
         self._release_actor_resources(ent)
@@ -2565,6 +3025,7 @@ class Controller:
         if a.get("worker_id"):
             await self._device_objects_lost(a["worker_id"], "process died")
             await self._lease_worker_died(a["worker_id"], cause=cause)
+            self._telem_purge_worker(a["worker_id"])
         actor_id = a.get("actor_id")
         task_id = a.get("task_id")
         if actor_id:
@@ -3052,20 +3513,18 @@ class Controller:
         (reference: dashboard -> reporter agent py-spy)."""
         nid = a.get("node_id")
         if nid is None:
-            # find the node by worker id from the lease/actor tables
-            for ent in self.actors.values():
-                if ent.worker_id == a["worker_id"]:
-                    nid = ent.node_id
-                    break
-            if nid is None:
-                for lease in self.leases.values():
-                    if lease["worker_id"] == a["worker_id"]:
-                        nid = lease["node_id"]
-                        break
-            if nid is None:
+            hits = self._find_worker_nodes(a["worker_id"])
+            if len(hits) > 1:
+                return {"found": False,
+                        "stacks": f"worker id prefix "
+                                  f"{a['worker_id'][:12]!r} is ambiguous "
+                                  f"({len(hits)} nodes match) — use a "
+                                  f"longer prefix"}
+            if not hits:
                 return {"found": False,
                         "stacks": f"worker {a['worker_id'][:12]} not found "
-                                  f"in the actor or lease tables"}
+                                  f"in the actor, lease, or dispatch tables"}
+            nid = next(iter(hits))
         nconn = self.node_conns.get(nid)
         if nconn is None or nconn.closed:
             return {"found": False, "stacks": "node not found"}

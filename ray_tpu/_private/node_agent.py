@@ -19,7 +19,7 @@ import time
 from collections import deque
 from typing import Optional
 
-from ray_tpu._private import rpc
+from ray_tpu._private import rpc, telemetry
 from ray_tpu._private.ids import WorkerID
 from ray_tpu._private.object_store import LocalStore
 from ray_tpu._private.rtconfig import CONFIG
@@ -99,6 +99,14 @@ class NodeAgent:
         # /api/stacks probes share one append-mode dump file per pid, and
         # an unserialized second truncate would cut the first's read short.
         self._stack_locks: dict[int, asyncio.Lock] = {}
+        # Telemetry plane (README "Telemetry & profiling"): sample batches
+        # awaiting the next heartbeat (None while RT_TELEMETRY_INTERVAL_S
+        # is unset — the heartbeat frame then stays byte-identical, pinned
+        # by test) and the latest device-side series each worker pushed.
+        self._telem_pending: deque | None = None
+        self._worker_device_series: dict[str, dict] = {}
+        self._node_cpu: telemetry.CpuTracker | None = None
+        self._worker_cpu: telemetry.PidCpuTracker | None = None
         # Direct-path task dedup (at-most-once across owner failover): a
         # leased worker whose owner connection severed reports the spec it
         # is still running (`ltask_running`) and its eventual outcome
@@ -143,6 +151,18 @@ class NodeAgent:
         self.logs_enabled = bool(rep.get("log_sub", False))
         self._tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
         self._tasks.append(asyncio.ensure_future(self._reap_loop()))
+        if telemetry.interval_s() > 0:
+            # Bounded: a controller outage must not grow an unbounded
+            # sample backlog — oldest batches shed, ring discipline. Sized
+            # so a full heartbeat interval of ticks fits with slack (a
+            # fast sampler under a slow heartbeat must not shed in steady
+            # state), never below the 16-batch outage floor.
+            per_beat = CONFIG.heartbeat_interval_s / max(
+                0.05, telemetry.interval_s())
+            self._telem_pending = deque(maxlen=max(16, int(per_beat) + 8))
+            self._node_cpu = telemetry.CpuTracker()
+            self._worker_cpu = telemetry.PidCpuTracker()
+            self._tasks.append(asyncio.ensure_future(self._telemetry_loop()))
         if CONFIG.memory_monitor_refresh_ms > 0:
             self._tasks.append(asyncio.ensure_future(self._memory_monitor_loop()))
         if CONFIG.prestart_workers and self.resources_raw.get("CPU", 0) > 0:
@@ -309,6 +329,8 @@ class NodeAgent:
             return {"workers": out}
         if method == "worker_stacks":
             return await self._worker_stacks(a["worker_id"])
+        if method == "profile_worker":
+            return await self._profile_worker(a)
         if method == "run_job":
             return self._run_job(a)
         if method == "stop_job":
@@ -325,7 +347,8 @@ class NodeAgent:
 
         from ray_tpu._private.rtconfig import stack_dump_path
 
-        slot = self.workers.get(worker_id)
+        wid = self._resolve_worker_id(worker_id)
+        slot = self.workers.get(wid) if wid else None
         if slot is None or slot.proc.poll() is not None:
             return {"found": False, "stacks": ""}
         pid = slot.proc.pid
@@ -599,6 +622,7 @@ class NodeAgent:
         # accumulate duplicates).
         while True:
             await asyncio.sleep(CONFIG.heartbeat_interval_s)
+            telem = None
             try:
                 beat = dict(node_id=self.node_id,
                             incarnation=self.incarnation,
@@ -606,9 +630,174 @@ class NodeAgent:
                 beacons = self._beacon_ages()
                 if beacons:  # frame unchanged when the watchdog is idle
                     beat["beacons"] = beacons
+                if self._telem_pending:
+                    # Telemetry piggybacks on the heartbeat (no new
+                    # connection or cadence — the PR 11 span-drain shape);
+                    # with sampling off the frame is byte-identical.
+                    telem = [self._telem_pending.popleft()
+                             for _ in range(len(self._telem_pending))]
+                    beat["telemetry"] = telem
                 await self.controller.push("heartbeat", **beat)
             except Exception:
+                if telem and self._telem_pending is not None:
+                    # Controller away: requeue BEHIND anything the sampler
+                    # appended during the failed push, so the bounded
+                    # deque's append-side overflow sheds the OLDEST
+                    # batches under a long outage (extendleft would evict
+                    # the freshest instead).
+                    fresh = list(self._telem_pending)
+                    self._telem_pending.clear()
+                    self._telem_pending.extend(telem + fresh)
                 continue
+
+    # ----------------------------------------------------------- telemetry
+    async def _telemetry_loop(self):
+        """Per-node resource sampling (README "Telemetry & profiling"):
+        node CPU/mem/disk + per-worker RSS/CPU% each tick, merged with the
+        device-side series workers push (`worker_telemetry`). Batches park
+        in a bounded ring until the next heartbeat carries them."""
+        interval = max(0.05, telemetry.interval_s())
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                self._telem_pending.append(self._sample_telemetry())
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.debug("telemetry sample tick failed", exc_info=True)
+
+    def _sample_telemetry(self) -> dict:
+        """One sample batch (sync — /proc reads are microseconds; the same
+        off-loop-call shape as _memory_usage_fraction)."""
+        workers: dict[str, dict] = {}
+        total_rss = 0
+        running = 0
+        live_pids = []
+        for wid, slot in self.workers.items():
+            if slot.proc.poll() is not None:
+                continue
+            pid = slot.proc.pid
+            live_pids.append(pid)
+            if slot.state in ("busy", "actor"):
+                running += 1
+            w: dict = {"cpu": self._worker_cpu.percent(pid)}
+            rss = telemetry.pid_rss_bytes(pid)
+            if rss is not None:
+                w["rss"] = rss
+                total_rss += rss
+            dev = self._worker_device_series.get(wid)
+            if dev:
+                # Staleness bound: a worker whose sampler stopped pushing
+                # (GIL-holding native call, failed pushes) must not have
+                # its last hbm/compile values re-stamped as fresh forever.
+                series, pushed = dev
+                if time.monotonic() - pushed < 3.0 * max(
+                        0.05, telemetry.interval_s()) + 1.0:
+                    w.update(series)
+                else:
+                    self._worker_device_series.pop(wid, None)
+            workers[wid] = w
+        self._worker_cpu.prune(live_pids)
+        node = {
+            "cpu": self._node_cpu.percent(),
+            "mem": telemetry.mem_percent(),
+            "disk": telemetry.disk_percent(CONFIG.session_dir),
+            "rss": total_rss,
+            "tasks_running": running,
+        }
+        return {"ts": time.time(), "node": node, "workers": workers}
+
+    async def _profile_worker(self, a: dict) -> dict:
+        """On-demand profile capture of a live worker (reference: the
+        reporter agent's py-spy endpoints). The worker runs the sampler
+        in-process (its IO loop stays free while the exec thread works);
+        the agent persists the rendered profile through the storage plane
+        under <session>/profiles/ and returns the metadata row. A worker
+        dying mid-capture is an attributed error, never a hang (the
+        capture call is bounded and the conn close fails it fast)."""
+        req = a.get("worker_id") or ""
+        wid = self._resolve_worker_id(req)
+        slot = self.workers.get(wid) if wid else None
+        if slot is None or slot.proc.poll() is not None or slot.conn is None \
+                or slot.conn.closed:
+            nmatch = sum(1 for w in self.workers if w.startswith(req))
+            if wid is None and nmatch > 1:
+                return {"found": False,
+                        "error": f"worker id prefix {req[:12]!r} is "
+                                 f"ambiguous on node {self.node_id[:8]} "
+                                 f"({nmatch} workers match) — use a "
+                                 f"longer prefix"}
+            return {"found": False,
+                    "error": f"worker {req[:12]} not "
+                             f"alive on node {self.node_id[:8]}"}
+        seconds = telemetry.clamp_profile_seconds(a.get("seconds"))
+        mode = a.get("mode") or "cpu"
+        if mode not in ("cpu", "jax"):
+            return {"found": False, "error": f"unknown profile mode {mode!r}"}
+        try:
+            rep = await slot.conn.call(
+                "profile", mode=mode, seconds=seconds, hz=a.get("hz"),
+                _timeout=seconds + 30.0)
+        except Exception as e:
+            return {"found": False,
+                    "error": f"worker {wid[:12]} died or failed mid-capture "
+                             f"({type(e).__name__}: {e}); profile aborted"}
+        rep.update(worker_id=wid, node_id=self.node_id,
+                   task_id=slot.task_id, actor_id=slot.actor_id,
+                   created=time.time())
+        try:
+            meta = await asyncio.to_thread(self._persist_profile, wid, rep)
+        except Exception as e:
+            return {"found": False,
+                    "error": f"profile captured but persist failed: {e!r}"}
+        try:
+            # Authoritative KV registration: a persist slower than the
+            # controller's profile_worker timeout means the reply below is
+            # dropped — this push still indexes the document so it never
+            # orphans in the storage plane (controller dedups with the
+            # reply-path registration).
+            await self.controller.push("profile_persisted", profile=meta)
+        except Exception:
+            pass  # reply path registers; a lost push costs nothing
+        return {"found": True, "profile": meta}
+
+    def _resolve_worker_id(self, wid: str) -> str | None:
+        """Exact worker id, or a unique prefix (CLI ergonomics — `ray-tpu
+        top` prints 12-char prefixes)."""
+        if wid in self.workers:
+            return wid
+        matches = [w for w in self.workers if w.startswith(wid)] if wid else []
+        return matches[0] if len(matches) == 1 else None
+
+    def _persist_profile(self, wid: str, rep: dict) -> dict:
+        """Write the captured profile through the PR 8 storage backend
+        (sync; runs in a thread). cpu -> one JSON doc (meta + collapsed
+        stacks + Chrome-trace events); jax -> JSON meta + sibling .zip of
+        the jax.profiler trace directory."""
+        import json as _json
+
+        from ray_tpu import storage
+
+        pdir = telemetry.default_profile_dir(self.session_id)
+        name = (f"{int((rep.get('created') or time.time()) * 1000)}"
+                f"_{wid[:12]}_{rep.get('mode')}")
+        storage.makedirs(pdir)
+        doc = dict(rep)
+        archive = doc.pop("archive", None)
+        if archive is not None:
+            apath = storage.join(pdir, name + ".zip")
+            storage.put(apath, archive)
+            doc["archive_path"] = apath
+        path = storage.join(pdir, name + ".json")
+        doc["name"] = name
+        doc["path"] = path
+        storage.put(path, _json.dumps(doc, default=str).encode())
+        meta = {k: doc.get(k) for k in
+                ("name", "path", "archive_path", "mode", "worker_id",
+                 "node_id", "task_id", "actor_id", "pid", "seconds", "hz",
+                 "samples", "files", "created")}
+        meta["stacks"] = len(doc.get("collapsed") or {})
+        return {k: v for k, v in meta.items() if v is not None}
 
     # ----------------------------------------------------- worker channel
     async def _on_request(self, conn, method, a):
@@ -672,6 +861,13 @@ class NodeAgent:
             slot = self.workers.get(a["worker_id"])
             if slot is not None:
                 slot.device_pinned = bool(a.get("pinned"))
+        elif method == "worker_telemetry":
+            # Latest device-side series from a worker's sampler thread;
+            # merged into the next node sample batch. Unknown worker ids
+            # (a late push racing the exit path) are dropped.
+            if a["worker_id"] in self.workers:
+                self._worker_device_series[a["worker_id"]] = (
+                    a["series"], time.monotonic())
         elif method == "watchdog_beacon":
             slot = self.workers.get(a["worker_id"])
             if slot is not None:
@@ -1030,11 +1226,13 @@ class NodeAgent:
             slot.proc.poll()
             self.workers.pop(slot.worker_id, None)
             self._purge_direct_tasks(slot.worker_id)
+            self._worker_device_series.pop(slot.worker_id, None)
             return
         prev_state = slot.state
         slot.state = "dead"
         self.workers.pop(slot.worker_id, None)
         self._purge_direct_tasks(slot.worker_id)
+        self._worker_device_series.pop(slot.worker_id, None)
         if prev_state in ("busy", "actor", "leased") or slot.actor_id:
             try:
                 await self.controller.push(

@@ -205,6 +205,27 @@ _flag("trace_dir", str, "")
 # than this records a root span anyway, so tail latency outliers stay
 # visible under tight head sampling. <=0 disables the escalation.
 _flag("trace_slow_s", float, 0.0)
+# --- cluster telemetry & profiling (README "Telemetry & profiling") ---------
+# Continuous resource sampling cadence: each node agent samples node
+# CPU/mem/disk + per-worker RSS/CPU%, and each worker samples device-side
+# series (jax HBM in-use/peak, compile count/seconds, device-object bytes)
+# on this tick; samples piggyback on the existing agent heartbeats. <= 0 /
+# unset disables the plane entirely: no sampler thread anywhere, heartbeat
+# frames byte-identical (pinned by test).
+_flag("telemetry_interval_s", float, 0.0)
+# Controller-side retention: a per-(node, series) downsampling ring keeps
+# raw recent points plus decimated history; series with no new point for
+# window_s age out (a dead agent's series disappear instead of freezing).
+_flag("telemetry_window_s", float, 600.0)
+# Points kept per series tier (raw + decimated history each hold this many).
+_flag("telemetry_points", int, 240)
+# On-demand CPU profiling (`ray-tpu profile --mode cpu`): the in-process
+# sampling profiler walks every worker thread's stack this many times per
+# second for the capture window.
+_flag("profile_hz", int, 100)
+# Storage-plane URI captured profiles persist under (any backend);
+# "" = <session_dir>/<session>/profiles.
+_flag("profile_dir", str, "")
 # --- kernels / diagnostics --------------------------------------------------
 # Decode-attention kernel selection: "pallas" / "xla" force a path, ""
 # keeps the size-based dispatch (ops/decode_attention.py

@@ -24,6 +24,7 @@ import time
 import traceback
 
 from ray_tpu._private import device_store, rpc, watchdog
+from ray_tpu._private import telemetry as _telemetry
 from ray_tpu._private import tracing as _tracing
 from ray_tpu._private import runtime_env as _rtenv
 from ray_tpu._private.rtconfig import CONFIG
@@ -218,6 +219,7 @@ class WorkerProc:
         async def _join_agent():
             self.agent_conn = await rpc.connect(
                 *self.agent_addr,
+                on_request=self._on_agent_request,
                 on_push=self._on_agent_push,
                 on_close=lambda c: os._exit(0) if self._running else None,
             )
@@ -226,6 +228,17 @@ class WorkerProc:
             )
 
         self.worker.io.run(_join_agent(), timeout=CONFIG.connect_timeout_s)
+        # Telemetry sampler (README "Telemetry & profiling"): device-side
+        # series (jax HBM, compile events, device-object bytes) pushed to
+        # the agent each tick. RT_TELEMETRY_INTERVAL_S unset => no thread,
+        # nothing pushed — byte-identical off, pinned by test.
+        if _telemetry.interval_s() > 0:
+            self._telem_sampler = _telemetry.WorkerSampler(
+                push=lambda series: self.agent_conn.push_threadsafe(
+                    "worker_telemetry", worker_id=self.worker_id,
+                    series=series),
+                interval=_telemetry.interval_s())
+            self._telem_sampler.start()
         # Stall watchdog: monitors every executing task's progress beacon
         # and walks the warn -> dump -> kill ladder through the node agent.
         # With all RT_STALL_* stages unset, start() is a no-op (no thread,
@@ -316,6 +329,28 @@ class WorkerProc:
             "message": f"task {spec.name} (attempt {spec.attempt}) exceeded "
                        f"its per-attempt timeout of {spec.timeout_s}s"})
         return [h, *bufs], True
+
+    async def _on_agent_request(self, conn, method, a):
+        """Agent->worker requests (the heartbeat/telemetry plane's only
+        request path; execution orders stay pushes)."""
+        if method == "profile":
+            # On-demand capture (README "Telemetry & profiling"). Runs on
+            # an executor thread: the capture loop sleeps between samples,
+            # and this IO loop keeps carrying beacons/replies meanwhile —
+            # which is exactly why a busy worker can be profiled live.
+            mode = a.get("mode") or "cpu"
+            seconds = _telemetry.clamp_profile_seconds(a.get("seconds"))
+            loop = asyncio.get_running_loop()
+            if mode == "cpu":
+                hz = a.get("hz")
+                return await loop.run_in_executor(
+                    None, lambda: _telemetry.sample_profile(
+                        seconds, int(hz) if hz else None))
+            if mode == "jax":
+                return await loop.run_in_executor(
+                    None, lambda: _telemetry.jax_profile(seconds))
+            raise rpc.RpcError(f"unknown profile mode {mode!r}")
+        raise rpc.RpcError(f"worker: unknown agent method {method}")
 
     async def _on_agent_push(self, conn, method, a):
         if method == "execute":

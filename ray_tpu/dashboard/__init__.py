@@ -32,16 +32,23 @@ _INDEX_HTML = """<!doctype html><html><head><title>ray_tpu dashboard</title>
  th{color:#9aa6b2;font-weight:600} .ok{color:#7ee787} .bad{color:#ff7b72}
  #meta{color:#9aa6b2;font-size:.8rem} a{color:#8ab4f8}
  .pill{display:inline-block;padding:0 .45rem;border-radius:.6rem;background:#1d2630;margin-right:.6rem}
+ .spark{display:inline-block;margin:0 1rem .3rem 0}
+ .spark svg{vertical-align:middle;background:#161c22;border-radius:3px}
+ .spark .lbl{color:#9aa6b2;font-size:.75rem;margin-right:.3rem}
+ .spark .val{color:#7ee787;font-size:.75rem;margin-left:.3rem}
 </style></head><body>
 <h1>ray_tpu dashboard</h1>
 <div id="meta"></div>
 <div id="res"></div>
+<div id="util"></div>
 <h2>Nodes</h2><table id="nodes"></table>
 <h2>Actors</h2><table id="actors"></table>
 <h2>Jobs</h2><table id="jobs"></table>
 <h2>Recent tasks</h2><table id="tasks"></table>
 <p><a href="/api/timeline">timeline</a> (chrome trace; load in Perfetto) &middot;
 <a href="/api/traces">traces</a> (causal spans; RT_TRACING=1) &middot;
+<a href="/api/timeseries">timeseries</a> (RT_TELEMETRY_INTERVAL_S) &middot;
+<a href="/api/profiles">profiles</a> (ray-tpu profile) &middot;
 <a href="/metrics">prometheus /metrics</a></p>
 <script>
 const esc=(v)=>String(v).replace(/&/g,"&amp;").replace(/</g,"&lt;")
@@ -58,7 +65,43 @@ function table(el,rows,cols){
   document.getElementById(el).innerHTML=h;
 }
 async function j(u){const r=await fetch(u);return r.json()}
+function spark(pts,w,h){ // inline SVG polyline over [[ts,v],...]
+  if(!pts.length) return "";
+  const t0=pts[0][0],t1=pts[pts.length-1][0]||t0+1;
+  let hi=Math.max(...pts.map(p=>p[1]),1e-9),lo=Math.min(...pts.map(p=>p[1]),0);
+  if(hi===lo) hi=lo+1;
+  const xy=pts.map(p=>((p[0]-t0)/Math.max(1e-9,t1-t0)*(w-2)+1).toFixed(1)+","+
+    ((h-1)-(p[1]-lo)/(hi-lo)*(h-2)).toFixed(1)).join(" ");
+  return "<svg width='"+w+"' height='"+h+"'><polyline fill='none' "+
+    "stroke='#8ab4f8' stroke-width='1' points='"+xy+"'/></svg>";
+}
+async function util(){ // live sparkline row (RT_TELEMETRY_INTERVAL_S armed)
+  try{
+    // no since= (browser clocks skew vs the controller host); prefix
+    // filters keep per-worker series out of the 2s poll entirely, and we
+    // window the tail client-side against the server's own clock.
+    const [tn,tc]=await Promise.all([
+      j("/api/timeseries?series=node."),
+      j("/api/timeseries?series=ctrl.loop_lag_s")]);
+    const ts={now:tn.now,series:(tn.series||[]).concat(tc.series||[])};
+    const rows=ts.series.filter(r=>!r.worker_id&&
+      ["node.cpu","node.mem","node.rss","node.tasks_running",
+       "ctrl.loop_lag_s"].includes(r.series));
+    let h="";
+    for(const r of rows){
+      const pts=r.points.filter(p=>p[0]>ts.now-120).slice(-120);
+      if(!pts.length) continue;
+      const last=pts[pts.length-1][1];
+      h+="<span class='spark'><span class='lbl'>"+esc(r.node_id.slice(0,8))+
+        " "+esc(r.series)+"</span>"+spark(pts,120,24)+
+        "<span class='val'>"+esc(typeof last==="number"?
+        (last>=1e6?(last/1048576).toFixed(0)+"M":last):last)+"</span></span>";
+    }
+    document.getElementById("util").innerHTML=h;
+  }catch(e){}
+}
 async function tick(){
+  util();
   try{
     const [st,nodes,actors,jobs,tasks]=await Promise.all([
       j("/api/cluster_status"),j("/api/nodes"),j("/api/actors"),
@@ -80,6 +123,58 @@ tick();setInterval(tick,2000);
 </script></body></html>"""
 
 
+def render_prometheus(metrics: list[dict]) -> str:
+    """Prometheus text exposition from aggregated metric entries.
+
+    Grouped per family FIRST so `# HELP`/`# TYPE` are emitted exactly once
+    per metric name even when series with different tag sets interleave in
+    the input (and HELP comes from whichever series carries a description,
+    not just the first seen). Histogram cumulative buckets: the `+Inf`
+    bucket equals `_count` by construction — the finite loop consumes
+    buckets[:-1] and the overflow bucket buckets[-1] is added exactly once
+    (pinned against empty AND non-empty overflow buckets in
+    tests/test_telemetry.py)."""
+
+    def esc(v) -> str:
+        # Prometheus label-value escaping: backslash, quote, newline.
+        return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+                .replace("\n", "\\n"))
+
+    families: dict[str, dict] = {}
+    for m in metrics:
+        name = m["name"].replace(".", "_").replace("-", "_")
+        fam = families.setdefault(name, {"kind": m["kind"], "desc": "",
+                                         "series": []})
+        if m.get("desc") and not fam["desc"]:
+            fam["desc"] = m["desc"]
+        fam["series"].append(m)
+    lines: list[str] = []
+    for name, fam in families.items():
+        kind = {"counter": "counter", "gauge": "gauge",
+                "histogram": "histogram"}.get(fam["kind"], "untyped")
+        if fam["desc"]:
+            lines.append(f"# HELP {name} {esc(fam['desc'])}")
+        lines.append(f"# TYPE {name} {kind}")
+        for m in fam["series"]:
+            tag_str = ",".join(f'{k}="{esc(v)}"'
+                               for k, v in sorted(m["tags"].items()))
+            label = f"{{{tag_str}}}" if tag_str else ""
+            if m["kind"] == "histogram" and m.get("buckets") is not None:
+                cum = 0
+                sep = "," if tag_str else ""
+                for bound, n in zip(m["boundaries"], m["buckets"]):
+                    cum += n
+                    lines.append(
+                        f'{name}_bucket{{{tag_str}{sep}le="{bound}"}} {cum}')
+                cum += m["buckets"][-1]
+                lines.append(f'{name}_bucket{{{tag_str}{sep}le="+Inf"}} {cum}')
+                lines.append(f"{name}_sum{label} {m['sum']}")
+                lines.append(f"{name}_count{label} {m['count']}")
+            else:
+                lines.append(f"{name}{label} {m['value']}")
+    return "\n".join(lines) + "\n"
+
+
 class Dashboard:
     """Serves cluster state as JSON over HTTP. Runs its own event-loop
     thread and a single controller connection; safe to start from any
@@ -95,16 +190,30 @@ class Dashboard:
         self._runner = None
 
     async def _a_call(self, method: str, **kw):
-        if self._conn_lock is None:
-            self._conn_lock = asyncio.Lock()
-        async with self._conn_lock:  # concurrent handlers must share one conn
-            if self._conn is None or self._conn.closed:
-                self._conn = await rpc.connect(*self._ctrl_addr)
-                await self._conn.call("register", kind="client",
-                                      worker_id=f"dashboard-{os.getpid()}",
-                                      address=None)
-            conn = self._conn
-        return await conn.call(method, **kw)
+        # Retry ONCE on a closed/severed controller connection: a
+        # controller restart (or a mid-poll sever) must cost one failed
+        # call, not a 500 on every panel until the dashboard process is
+        # bounced (chaos-pinned in tests/test_chaos_telemetry.py).
+        last_exc: Exception | None = None
+        for attempt in range(2):
+            if self._conn_lock is None:
+                self._conn_lock = asyncio.Lock()
+            async with self._conn_lock:  # concurrent handlers share one conn
+                if self._conn is None or self._conn.closed:
+                    self._conn = await rpc.connect(*self._ctrl_addr,
+                                                   label="dashboard")
+                    await self._conn.call("register", kind="client",
+                                          worker_id=f"dashboard-{os.getpid()}",
+                                          address=None)
+                conn = self._conn
+            try:
+                return await conn.call(method, **kw)
+            except (rpc.ConnectionClosed, ConnectionError, OSError) as e:
+                last_exc = e
+                async with self._conn_lock:
+                    if self._conn is conn:  # don't drop a fresher reconnect
+                        self._conn = None
+        raise last_exc
 
     # ------------------------------------------------------------ server
     def start(self) -> int:
@@ -123,6 +232,8 @@ class Dashboard:
             app.router.add_get("/api/objects", self._objects)
             app.router.add_get("/api/jobs", self._jobs)
             app.router.add_get("/api/timeline", self._timeline)
+            app.router.add_get("/api/timeseries", self._timeseries)
+            app.router.add_get("/api/profiles", self._profiles)
             app.router.add_get("/api/traces", self._traces)
             app.router.add_get("/api/stacks", self._stacks)
             app.router.add_get("/api/metrics", self._metrics_json)
@@ -223,6 +334,39 @@ class Dashboard:
                                  node_id=request.query.get("node_id"))
         return web.json_response(rep)
 
+    async def _timeseries(self, request):
+        """Telemetry timeseries (README "Telemetry & profiling"):
+        /api/timeseries?series=&node_id=&since= — series match exactly or
+        by prefix (`node.` = family); needs a cluster running with
+        RT_TELEMETRY_INTERVAL_S set."""
+        from aiohttp import web
+
+        kw = {}
+        if request.query.get("series"):
+            kw["series"] = request.query["series"]
+        if request.query.get("node_id"):
+            kw["node_id"] = request.query["node_id"]
+        if request.query.get("since"):
+            kw["since"] = float(request.query["since"])
+        rep = await self._a_call("timeseries", **kw)
+        return web.json_response(rep)
+
+    async def _profiles(self, request):
+        """Captured worker profiles: /api/profiles lists the registry;
+        /api/profiles?name=<name-or-prefix> fetches one persisted profile
+        document (collapsed stacks + Chrome-trace events)."""
+        from aiohttp import web
+
+        name = request.query.get("name")
+        if not name:
+            limit = int(request.query.get("limit", 1000))
+            rep = await self._a_call("list_profiles", limit=limit)
+            return web.json_response(rep)
+        rep = await self._a_call("get_profile", name=name)
+        if not rep.get("found"):
+            return web.json_response(rep, status=404)
+        return web.json_response(rep)
+
     async def _metrics_json(self, request):
         from aiohttp import web
 
@@ -235,40 +379,7 @@ class Dashboard:
         from aiohttp import web
 
         rep = await self._a_call("get_metrics")
-        lines = []
-        seen_help = set()
-
-        def esc(v) -> str:
-            # Prometheus label-value escaping: backslash, quote, newline.
-            return (str(v).replace("\\", "\\\\").replace('"', '\\"')
-                    .replace("\n", "\\n"))
-
-        for m in rep["metrics"]:
-            name = m["name"].replace(".", "_").replace("-", "_")
-            if name not in seen_help:
-                seen_help.add(name)
-                kind = {"counter": "counter", "gauge": "gauge",
-                        "histogram": "histogram"}[m["kind"]]
-                if m.get("desc"):
-                    lines.append(f"# HELP {name} {m['desc']}")
-                lines.append(f"# TYPE {name} {kind}")
-            tag_str = ",".join(f'{k}="{esc(v)}"' for k, v in sorted(m["tags"].items()))
-            label = f"{{{tag_str}}}" if tag_str else ""
-            if m["kind"] == "histogram" and m.get("buckets") is not None:
-                cum = 0
-                for bound, n in zip(m["boundaries"], m["buckets"]):
-                    cum += n
-                    sep = "," if tag_str else ""
-                    lines.append(
-                        f'{name}_bucket{{{tag_str}{sep}le="{bound}"}} {cum}')
-                cum += m["buckets"][-1]
-                sep = "," if tag_str else ""
-                lines.append(f'{name}_bucket{{{tag_str}{sep}le="+Inf"}} {cum}')
-                lines.append(f"{name}_sum{label} {m['sum']}")
-                lines.append(f"{name}_count{label} {m['count']}")
-            else:
-                lines.append(f"{name}{label} {m['value']}")
-        return web.Response(text="\n".join(lines) + "\n",
+        return web.Response(text=render_prometheus(rep["metrics"]),
                             content_type="text/plain")
 
     async def _traces(self, request):

@@ -378,6 +378,159 @@ def cmd_stalls(args) -> int:
     return 0
 
 
+def _fmt_bytes(n) -> str:
+    if n is None:
+        return "-"
+    n = float(n)
+    for unit in ("B", "K", "M", "G", "T"):
+        if n < 1024 or unit == "T":
+            return f"{n:.0f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return "-"
+
+
+def _top_lines(rep: dict) -> list[str]:
+    """Render one `ray-tpu top` frame from a cluster_utilization reply:
+    one row per node (per-worker device series aggregated up), DEAD nodes
+    marked rather than freezing their last values."""
+    lines = [f"{'NODE':<10} {'STATE':<8} {'CPU%':>6} {'MEM%':>6} "
+             f"{'RSS':>8} {'HBM USED/PEAK':>16} {'COMPILE_S':>10} "
+             f"{'TASKS':>6}  WORKERS"]
+    nodes = rep.get("nodes") or {}
+    for nid in sorted(nodes):
+        n = nodes[nid]
+        dead = not n.get("alive")
+        state = (n.get("liveness") or ("ALIVE" if not dead else "DEAD"))
+        nd = n.get("node") or {}
+        workers = n.get("workers") or {}
+        # distinguish "no worker reports HBM" from a genuine 0 in-use
+        # (freed arrays must still show their peak)
+        have_hbm = any("hbm_used" in w for w in workers.values())
+        hbm_used = sum(w.get("hbm_used", 0)
+                       for w in workers.values()) if have_hbm else None
+        hbm_peak = sum(w.get("hbm_peak", 0)
+                       for w in workers.values()) if have_hbm else None
+        compile_s = sum(w.get("compile_s", 0.0) for w in workers.values())
+        if dead:
+            # A not-alive node's stale values must not render as live
+            # readings; keep the real liveness (SUSPECT nodes are frozen
+            # pending rejoin, not lost).
+            lines.append(f"{nid[:8]:<10} {state or 'DEAD':<8} {'-':>6} "
+                         f"{'-':>6} {'-':>8} {'-':>16} {'-':>10} {'-':>6}")
+            continue
+        hbm = (f"{_fmt_bytes(hbm_used)}/{_fmt_bytes(hbm_peak)}"
+               if hbm_used is not None else "-")
+        cpu = nd.get("cpu")
+        mem = nd.get("mem")
+        lines.append(
+            f"{nid[:8]:<10} {state:<8} "
+            f"{cpu if cpu is not None else '-':>6} "
+            f"{mem if mem is not None else '-':>6} "
+            f"{_fmt_bytes(nd.get('rss')):>8} {hbm:>16} "
+            f"{compile_s:>10.2f} "
+            f"{int(nd.get('tasks_running', 0)):>6}  {len(workers)}")
+    ctrl = rep.get("controller") or {}
+    tables = ctrl.get("tables") or {}
+    lag = ctrl.get("loop_lag_s")
+    lines.append(
+        f"controller: loop_lag={lag if lag is not None else '-'}s  "
+        f"objects={tables.get('objects', 0)} actors={tables.get('actors', 0)} "
+        f"leases={tables.get('leases', 0)} "
+        f"parked={tables.get('parked_grants', 0)} "
+        f"rpcs={ctrl.get('rpc_total', 0)}")
+    if not rep.get("telemetry_armed"):
+        lines.append("(telemetry idle — start the cluster with "
+                     "RT_TELEMETRY_INTERVAL_S=1 for live samples)")
+    return lines
+
+
+def cmd_top(args) -> int:
+    """`ray-tpu top` — live cluster utilization (README "Telemetry &
+    profiling"): one redraw-in-place row per node with cpu/mem/rss/hbm/
+    compile/tasks columns fed by the telemetry plane
+    (RT_TELEMETRY_INTERVAL_S), plus the controller's self-stats line.
+    Curses-free: plain ANSI cursor-up redraw; --once prints one frame."""
+    client = _Client(_resolve_address(args))
+    prev_lines = 0
+    try:
+        while True:
+            try:
+                rep = client.call("cluster_utilization")
+            except Exception as e:
+                # A transient controller blip (restart, timeout under
+                # load) must not crash a long-running monitor — _Client
+                # reconnects on the next call.
+                if args.once:
+                    raise
+                lines = [f"controller unreachable "
+                         f"({type(e).__name__}: {e}) — retrying"]
+            else:
+                lines = _top_lines(rep)
+            if prev_lines:
+                # redraw in place: cursor up + clear to end of screen
+                sys.stdout.write(f"\x1b[{prev_lines}F\x1b[J")
+            print("\n".join(lines), flush=True)
+            if args.once:
+                return 0
+            prev_lines = len(lines)
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        client.close()
+
+
+def cmd_profile(args) -> int:
+    """`ray-tpu profile --worker ID` — on-demand capture of a live worker
+    (README "Telemetry & profiling"). cpu: in-process sampling profiler
+    over the worker's threads, rendered as collapsed stacks + Chrome-trace
+    flame events; jax: a jax.profiler trace window zipped from the worker.
+    Captures persist through the storage plane under <session>/profiles/
+    and are listed by `/api/profiles` / `util.state.list_profiles()`."""
+    address = _resolve_address(args)
+    rep = _rpc_call(address, "profile_worker", timeout=args.seconds + 60,
+                    worker_id=args.worker, seconds=args.seconds,
+                    mode=args.mode)
+    if not rep.get("found"):
+        print(f"profile failed: {rep.get('error')}", file=sys.stderr)
+        return 1
+    meta = rep["profile"]
+    print(f"profiled worker {meta.get('worker_id', '')[:12]} "
+          f"({meta['mode']}, {meta.get('seconds')}s, "
+          f"{meta.get('samples', meta.get('files', 0))} samples)")
+    print(f"  persisted: {meta['path']}")
+    if meta.get("archive_path"):
+        print(f"  trace archive: {meta['archive_path']}")
+    if args.output and args.mode != "cpu":
+        print(f"-o applies to cpu mode only (jax captures persist as the "
+              f"trace archive above); {args.output} not written",
+              file=sys.stderr)
+    if args.mode == "cpu":
+        doc = _rpc_call(address, "get_profile", name=meta["name"],
+                        timeout=60)
+        if not doc.get("found"):
+            # The capture DID persist (path above); only the readback
+            # failed — say so instead of writing an empty trace as
+            # success.
+            print(f"profile persisted but fetch failed: "
+                  f"{doc.get('error')}", file=sys.stderr)
+            return 1
+        collapsed = doc.get("collapsed") or {}
+        if args.output:
+            with open(args.output, "w") as f:
+                json.dump({"traceEvents": doc.get("traceEvents") or [],
+                           "displayTimeUnit": "ms"}, f)
+            print(f"  wrote Chrome-trace JSON to {args.output} — open in "
+                  f"https://ui.perfetto.dev")
+        top = sorted(collapsed.items(), key=lambda kv: -kv[1])[:5]
+        if top:
+            print("  hottest stacks:")
+            for stack, count in top:
+                leaf = stack.rsplit(";", 1)[-1]
+                print(f"    {count:>5}  {leaf}")
+    return 0
+
+
 def _chrome_trace_events(spans: list) -> list[dict]:
     """Convert controller span dicts to Chrome-trace/Perfetto events:
     complete "X" events laned by (worker process, thread), plus "M"
@@ -605,6 +758,42 @@ def main(argv=None) -> int:
                     help="machine-readable findings for tooling")
     pn.add_argument("--no-cache", action="store_true")
     pn.set_defaults(fn=cmd_lint)
+
+    po = sub.add_parser(
+        "top",
+        help="live per-node utilization (cpu/mem/rss/hbm/compile/tasks)",
+        description="Redraw-in-place cluster utilization from the "
+                    "telemetry plane: per-node CPU/mem/RSS, aggregated "
+                    "worker HBM use, cumulative jax compile seconds, and "
+                    "running-task counts, plus the controller's self-stats "
+                    "(event-loop lag, table sizes). Arm sampling with "
+                    "RT_TELEMETRY_INTERVAL_S on the cluster.")
+    po.add_argument("--address", default=None)
+    po.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period seconds (default 2)")
+    po.add_argument("--once", action="store_true",
+                    help="print one frame and exit (no escape codes)")
+    po.set_defaults(fn=cmd_top)
+
+    pp = sub.add_parser(
+        "profile",
+        help="capture an on-demand profile of a live worker",
+        description="Ask the worker's node agent for a live capture: "
+                    "--mode cpu samples every thread's stack at "
+                    "RT_PROFILE_HZ for the window (collapsed stacks + "
+                    "Chrome-trace flame events); --mode jax records a "
+                    "jax.profiler trace window. Captures persist through "
+                    "the storage plane under <session>/profiles/ and are "
+                    "listed by /api/profiles and "
+                    "util.state.list_profiles().")
+    pp.add_argument("--address", default=None)
+    pp.add_argument("--worker", required=True,
+                    help="worker id (unique prefixes accepted)")
+    pp.add_argument("--seconds", type=float, default=5.0)
+    pp.add_argument("--mode", choices=("cpu", "jax"), default="cpu")
+    pp.add_argument("-o", "--output", default=None,
+                    help="also write the cpu flame Chrome-trace JSON here")
+    pp.set_defaults(fn=cmd_profile)
 
     pd = sub.add_parser("dashboard", help="serve the HTTP dashboard")
     pd.add_argument("--address", default=None)

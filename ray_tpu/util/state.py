@@ -18,10 +18,26 @@ def _call(method: str, **kw):
     return w.io.run(w.controller.call(method, **kw), timeout=30)
 
 
+class TruncatedList(list):
+    """A plain list plus a `truncated` flag: the uniform limit contract of
+    every list API — when the controller dropped rows beyond `limit=` the
+    flag is True instead of the caller silently seeing a short list."""
+
+    truncated: bool = False
+
+
+def _rows(rep: dict, key: str) -> TruncatedList:
+    rows = TruncatedList(rep[key])
+    rows.truncated = bool(rep.get("truncated"))
+    return rows
+
+
 def list_tasks(limit: int = 1000) -> list[dict]:
     """Executed tasks (from the task-event ring) plus live queued/running
-    ones; each row has task_id/name/kind/state/node/worker/timestamps."""
-    return _call("list_tasks", limit=limit)["tasks"]
+    ones; each row has task_id/name/kind/state/node/worker/timestamps.
+    Rows beyond `limit` drop oldest-first; the returned list's
+    `.truncated` is True when that happened."""
+    return _rows(_call("list_tasks", limit=limit), "tasks")
 
 
 def list_objects(limit: int = 1000) -> list[dict]:
@@ -29,8 +45,9 @@ def list_objects(limit: int = 1000) -> list[dict]:
     `plane` field: "host" for store/inline objects, "device" for entries
     whose payload is pinned in the producing worker's DeviceObjectTable
     (README "Device objects"); device residency totals are the
-    `rt_device_objects_{count,bytes}` gauges in `metrics()`."""
-    return _call("list_objects", limit=limit)["objects"]
+    `rt_device_objects_{count,bytes}` gauges in `metrics()`. `.truncated`
+    on the returned list marks a limit-clipped reply."""
+    return _rows(_call("list_objects", limit=limit), "objects")
 
 
 def list_actors(limit: int = 1000) -> list[dict]:
@@ -81,7 +98,7 @@ def list_stalls(limit: int = 1000) -> list[dict]:
     (beacons stopped), and train group-stall kills. Rows carry the task,
     where it ran, how long it was silent, the flight-recorder tail, and
     (dump/kill) the storage path of the persisted flight dump."""
-    return _call("list_stalls", limit=limit)["stalls"]
+    return _rows(_call("list_stalls", limit=limit), "stalls")
 
 
 def list_traces(limit: int = 1000) -> list[dict]:
@@ -89,8 +106,41 @@ def list_traces(limit: int = 1000) -> list[dict]:
     one row per trace_id — root name, start/end, span count, and whether
     the root span has landed (`complete`). Arm the plane with RT_TRACING=1
     (+ RT_TRACE_SAMPLE for head-based sampling); export any row with
-    `ray-tpu timeline --trace <id>` or `get_trace()`."""
-    return _call("list_traces", limit=limit)["traces"]
+    `ray-tpu timeline --trace <id>` or `get_trace()`. `.truncated` marks
+    a limit-clipped reply."""
+    return _rows(_call("list_traces", limit=limit), "traces")
+
+
+def list_profiles(limit: int = 1000) -> list[dict]:
+    """Captured worker profiles (README "Telemetry & profiling"): one
+    metadata row per `ray-tpu profile` / `profile_worker` capture, newest
+    last — worker/node, mode (cpu|jax), sample counts, and the storage
+    path of the persisted document (`/api/profiles?name=` fetches it)."""
+    return _rows(_call("list_profiles", limit=limit), "profiles")
+
+
+def timeseries(series: str | None = None, node_id: str | None = None,
+               since: float | None = None) -> list[dict]:
+    """Telemetry timeseries rows (README "Telemetry & profiling"): each is
+    {node_id, series, worker_id, points=[[ts, value], ...]} with strictly
+    monotone timestamps. `series` matches exactly or as a prefix
+    ("node." selects the family). Needs RT_TELEMETRY_INTERVAL_S set."""
+    kw: dict = {}
+    if series is not None:
+        kw["series"] = series
+    if node_id is not None:
+        kw["node_id"] = node_id
+    if since is not None:
+        kw["since"] = since
+    return _call("timeseries", **kw)["series"]
+
+
+def cluster_utilization() -> dict:
+    """Latest telemetry sample per node/worker plus controller self-stats
+    (event-loop lag, table sizes) — the data behind `ray-tpu top`.
+    {nodes: {node_id: {alive, liveness, beat_age, node: {cpu, mem, ...},
+    workers: {wid: {rss, cpu, hbm_used, ...}}}}, controller: {...}}."""
+    return _call("cluster_utilization")
 
 
 def get_trace(trace_id: str) -> dict:

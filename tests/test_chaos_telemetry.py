@@ -1,0 +1,183 @@
+"""Chaos coverage for the telemetry & profiling plane (README "Telemetry
+& profiling"): a severed dashboard->controller connection recovers on the
+next poll (no dashboard bounce), agent death leaves no stuck series (they
+age out of the controller ring and `ray-tpu top` marks the node DEAD
+rather than freezing last values), worker death purges that worker's
+series immediately, and profiling a worker that dies mid-capture returns
+an attributed error instead of hanging.
+"""
+
+import threading
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import rpc
+from ray_tpu.util import state
+
+
+def test_dashboard_recovers_from_severed_controller_conn(ray_start_2cpu):
+    """Sever the dashboard's controller connection mid-poll: the next tick
+    must recover through the retry/reconnect path — before PR 12 a
+    controller-side conn loss could 500 every panel until the dashboard
+    process was bounced."""
+    import urllib.request
+
+    from ray_tpu.dashboard import start_dashboard
+
+    d = start_dashboard(port=0)
+    try:
+        def get_nodes():
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{d.port}/api/nodes", timeout=10) as r:
+                assert r.status == 200
+                return r.read()
+
+        assert b"node_id" in get_nodes()
+        for _ in range(3):  # sever repeatedly; every next poll must recover
+            conn = d._conn
+            assert conn is not None
+            rpc.FaultInjector.sever_conn(conn)
+            deadline = time.monotonic() + 5
+            while not conn.closed and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert b"node_id" in get_nodes()
+    finally:
+        d.stop()
+
+
+def test_agent_death_ages_out_series_and_top_marks_dead(monkeypatch):
+    """Kill a node's agent mid-sampling: its series stop arriving, age out
+    of the controller ring after RT_TELEMETRY_WINDOW_S, and the top
+    renderer shows the node DEAD instead of freezing its last values."""
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.scripts.cli import _top_lines
+
+    monkeypatch.setenv("RT_TELEMETRY_INTERVAL_S", "0.2")
+    monkeypatch.setenv("RT_TELEMETRY_WINDOW_S", "3")
+    cluster = Cluster(head_node_args={"num_cpus": 1})
+    try:
+        n2 = cluster.add_node(num_cpus=1)
+        ray_tpu.init(address=cluster.address)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            with_series = {r["node_id"] for r in state.timeseries(
+                series="node.cpu")}
+            if n2.node_id in with_series:
+                break
+            time.sleep(0.3)
+        assert n2.node_id in with_series, "second node never sampled"
+
+        cluster.remove_node(n2)  # SIGKILL: death mid-sample
+        # The ring must drain the dead node's series within the window
+        # (+ prune cadence slack); the surviving node keeps sampling.
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            rows = state.timeseries(node_id=n2.node_id)
+            if not rows:
+                break
+            time.sleep(0.5)
+        assert state.timeseries(node_id=n2.node_id) == [], (
+            "dead node's series never aged out")
+        assert state.timeseries(series="node.cpu"), (
+            "survivor's series vanished too")
+
+        u = state.cluster_utilization()
+        dead = u["nodes"][n2.node_id]
+        assert not dead["alive"]
+        rendered = "\n".join(_top_lines(u))
+        assert f"{n2.node_id[:8]:<10} DEAD" in rendered.replace(
+            "DEAD    ", "DEAD"), rendered
+    finally:
+        ray_tpu.shutdown()
+        cluster.shutdown()
+
+
+def test_worker_death_purges_its_series(monkeypatch, shutdown_only):
+    """Kill a worker mid-sampling: its worker-scoped rings are purged from
+    the controller immediately (not after the 600s window prune), so
+    cluster_utilization / `ray-tpu top` stop reporting the dead worker's
+    last RSS/CPU sample as current."""
+    monkeypatch.setenv("RT_TELEMETRY_INTERVAL_S", "0.2")
+    ray_tpu.init(num_cpus=2)
+
+    @ray_tpu.remote(max_restarts=0)
+    class Busy:
+        def spin(self, seconds):
+            t0 = time.time()
+            while time.time() - t0 < seconds:
+                pass
+            return 1
+
+    a = Busy.remote()
+    ref = a.spin.remote(30.0)
+    w = ray_tpu._private.worker.global_worker()
+    info = w.io.run(w.controller.call(
+        "get_actor_info", actor_id=a._actor_id, wait=True))
+    sub = info["worker_id"][:12]
+
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        if any(r["worker_id"] == sub for r in state.timeseries()):
+            break
+        time.sleep(0.3)
+    assert any(r["worker_id"] == sub for r in state.timeseries()), (
+        "actor worker never sampled")
+
+    ray_tpu.kill(a)
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if not any(r["worker_id"] == sub for r in state.timeseries()):
+            break
+        time.sleep(0.3)
+    assert not any(r["worker_id"] == sub for r in state.timeseries()), (
+        "dead worker's series were not purged")
+    workers = {wid for n in state.cluster_utilization()["nodes"].values()
+               for wid in (n.get("workers") or {})}
+    assert sub not in workers
+    del ref
+
+
+def test_profile_worker_death_mid_capture_attributed(ray_start_2cpu):
+    """Kill the worker while an 8s capture is in flight: the call returns
+    an attributed error well before the capture window would end — never
+    a hang, never a success."""
+
+    @ray_tpu.remote(max_restarts=0)
+    class Busy:
+        def spin(self, seconds):
+            t0 = time.time()
+            while time.time() - t0 < seconds:
+                pass
+            return 1
+
+    a = Busy.remote()
+    ref = a.spin.remote(30.0)
+    time.sleep(0.5)
+    w = ray_tpu._private.worker.global_worker()
+    info = w.io.run(w.controller.call(
+        "get_actor_info", actor_id=a._actor_id, wait=True))
+
+    result = {}
+
+    def capture():
+        result["rep"] = w.io.run(w.controller.call(
+            "profile_worker", worker_id=info["worker_id"], seconds=8.0,
+            mode="cpu"), timeout=60)
+
+    t0 = time.monotonic()
+    th = threading.Thread(target=capture, daemon=True)
+    th.start()
+    time.sleep(1.0)  # capture is mid-window
+    ray_tpu.kill(a)
+    th.join(timeout=20)
+    elapsed = time.monotonic() - t0
+    assert not th.is_alive(), "profile capture hung after worker death"
+    rep = result["rep"]
+    assert rep["found"] is False, rep
+    assert "mid-capture" in rep["error"] or "not alive" in rep["error"], rep
+    assert elapsed < 8.0, (
+        f"capture should abort on death, not run out the window "
+        f"({elapsed:.1f}s)")
+    del ref

@@ -1,0 +1,383 @@
+"""Telemetry & profiling plane (README "Telemetry & profiling").
+
+Covers: sampling-off is byte-identical inert (no sampler thread anywhere,
+no heartbeat telemetry key, empty controller ring); armed sampling yields
+multi-kind monotone timeseries + cluster_utilization; controller
+self-metrics (per-RPC-method latency histograms, table-size gauges) in
+get_metrics and the Prometheus exposition; exposition correctness (+Inf
+cumulative == _count for empty AND non-empty overflow buckets, one
+HELP/TYPE per family, label escaping round-trip); uniform list-API
+truncation markers; on-demand CPU profiling of a live worker end to end
+(capture -> storage persist -> registry -> /api/profiles fetch); and the
+`ray-tpu top` renderer.
+
+reference: dashboard/modules/reporter/ (reporter agent + metrics head) and
+util/state list APIs.
+"""
+
+import json
+import re
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.util import state
+
+
+@pytest.fixture
+def telemetry_cluster(monkeypatch, shutdown_only):
+    """Cluster with the sampling plane armed at a fast cadence (workers
+    inherit the env through the agent spawn path)."""
+    monkeypatch.setenv("RT_TELEMETRY_INTERVAL_S", "0.2")
+    ray_tpu.init(num_cpus=2)
+    yield
+
+
+def test_telemetry_off_is_inert(ray_start_2cpu):
+    """RT_TELEMETRY unset: no sampler thread in any worker, no agent
+    sample ring, no controller self-sample task, no series ever ingested —
+    the heartbeat wire shape is unchanged (the `telemetry` key is only
+    attached when the agent ring exists and is non-empty)."""
+    assert "RT_TELEMETRY_INTERVAL_S" not in __import__("os").environ
+
+    @ray_tpu.remote
+    def thread_names():
+        import threading
+
+        return sorted(t.name for t in threading.enumerate())
+
+    names = ray_tpu.get(thread_names.remote(), timeout=60)
+    assert not any("rt-telemetry" in n for n in names), names
+    head = ray_tpu._head
+    assert head.agent._telem_pending is None
+    assert head.controller._telem_task is None
+    time.sleep(3 * 0.5)  # several heartbeats
+    assert head.controller.telemetry == {}
+
+
+def test_timeseries_kinds_and_monotone_timestamps(telemetry_cluster):
+    @ray_tpu.remote
+    def work(i):
+        time.sleep(0.05)
+        return i
+
+    ray_tpu.get([work.remote(i) for i in range(4)], timeout=60)
+    deadline = time.monotonic() + 20
+    kinds = set()
+    while time.monotonic() < deadline:
+        rows = state.timeseries()
+        kinds = {r["series"] for r in rows}
+        if {"node.cpu", "node.rss", "worker.cpu"} <= kinds:
+            break
+        time.sleep(0.3)
+    # >= 3 distinct series kinds across node / worker / controller scopes
+    assert {"node.cpu", "node.rss", "worker.cpu"} <= kinds, kinds
+    assert any(k.startswith("ctrl.") for k in kinds), kinds
+    for r in state.timeseries():
+        ts = [p[0] for p in r["points"]]
+        assert ts == sorted(ts) and len(ts) == len(set(ts)), (
+            f"non-monotone timestamps in {r['series']}: {ts}")
+    # filters: exact series, family prefix, node scoping
+    only_cpu = state.timeseries(series="node.cpu")
+    assert only_cpu and all(r["series"] == "node.cpu" for r in only_cpu)
+    fam = state.timeseries(series="node.")
+    assert {r["series"] for r in fam} >= {"node.cpu", "node.rss"}
+    nid = only_cpu[0]["node_id"]
+    assert all(r["node_id"] == nid
+               for r in state.timeseries(node_id=nid))
+    assert state.timeseries(node_id="nonexistent") == []
+    # since= returns only strictly newer points
+    last = only_cpu[0]["points"][-1][0]
+    newer = state.timeseries(series="node.cpu", node_id=nid)
+    cut = [p for r in newer for p in r["points"] if p[0] <= last]
+    fresh = state.timeseries(series="node.cpu", node_id=nid, since=last)
+    assert all(p[0] > last for r in fresh for p in r["points"])
+    assert cut  # sanity: the cutoff actually removed something
+
+
+def test_cluster_utilization_shape(telemetry_cluster):
+    @ray_tpu.remote
+    def one():
+        return 1
+
+    ray_tpu.get([one.remote() for _ in range(3)], timeout=60)
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        u = state.cluster_utilization()
+        nodes = u["nodes"]
+        if nodes and all("cpu" in n["node"] for n in nodes.values()):
+            break
+        time.sleep(0.3)
+    assert u["telemetry_armed"]
+    node = next(iter(nodes.values()))
+    assert node["alive"] and {"cpu", "mem", "rss"} <= set(node["node"])
+    ctrl = u["controller"]
+    assert ctrl["loop_lag_s"] is not None
+    assert ctrl["tables"]["nodes"] == 1
+    assert ctrl["rpc_total"] > 0
+
+
+def test_controller_self_metrics(ray_start_2cpu):
+    """Per-RPC-method latency histograms + table-size gauges need NO
+    telemetry arming — they accumulate inline and synthesize at scrape."""
+    @ray_tpu.remote
+    def one():
+        return 1
+
+    ray_tpu.get(one.remote(), timeout=60)
+    metrics = state.metrics()
+    rpc_rows = [m for m in metrics
+                if m["name"] == "rt_controller_rpc_seconds"]
+    assert rpc_rows, "per-RPC histograms missing from get_metrics"
+    methods = {m["tags"]["method"] for m in rpc_rows}
+    assert "register" in methods, methods
+    for m in rpc_rows:
+        assert m["kind"] == "histogram"
+        assert sum(m["buckets"]) == m["count"]
+        assert len(m["buckets"]) == len(m["boundaries"]) + 1
+    tables = {m["tags"]["table"]: m["value"] for m in metrics
+              if m["name"] == "rt_controller_table_size"}
+    assert {"objects", "actors", "leases", "parked_grants"} <= set(tables)
+    assert tables["nodes"] == 1
+
+
+_PROM_SERIES = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(?P<labels>.*)\})? '
+    r'(?P<value>[^ ]+)$')
+
+
+def _parse_prom(text: str):
+    """Minimal Prometheus text parser: returns (types, helps, samples)
+    where samples is a list of (name, {label: value}, float)."""
+    types: dict[str, list] = {}
+    helps: dict[str, list] = {}
+    samples = []
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            types.setdefault(name, []).append(kind)
+            continue
+        if line.startswith("# HELP "):
+            _, _, name, desc = line.split(" ", 3)
+            helps.setdefault(name, []).append(desc)
+            continue
+        m = _PROM_SERIES.match(line)
+        assert m, f"unparseable exposition line: {line!r}"
+        labels = {}
+        raw = m.group("labels")
+        if raw:
+            for lm in re.finditer(r'(\w+)="((?:[^"\\]|\\.)*)"', raw):
+                val = (lm.group(2).replace("\\n", "\n")
+                       .replace('\\"', '"').replace("\\\\", "\\"))
+                labels[lm.group(1)] = val
+        samples.append((m.group("name"), labels, float(m.group("value"))))
+    return types, helps, samples
+
+
+def test_prometheus_exposition_correctness():
+    """Scrape-and-parse pin (no cluster needed — render_prometheus is the
+    single exposition implementation): +Inf cumulative bucket == _count
+    for a histogram whose overflow bucket is EMPTY and one whose overflow
+    is NON-EMPTY; TYPE/HELP exactly once per family even when tag sets
+    differ and only a later series carries the description; label values
+    with quotes/backslashes/newlines round-trip."""
+    from ray_tpu.dashboard import render_prometheus
+
+    weird = 'a"b\\c\nd'
+    metrics = [
+        # family h: two tag sets; desc only on the SECOND series
+        {"name": "h", "kind": "histogram", "desc": "",
+         "tags": {"m": "x"}, "value": 0.0, "count": 6, "sum": 1.5,
+         "boundaries": [0.1, 1.0], "buckets": [2, 4, 0]},   # empty +Inf
+        {"name": "h", "kind": "histogram", "desc": "h help",
+         "tags": {"m": "y"}, "value": 0.0, "count": 7, "sum": 9.0,
+         "boundaries": [0.1, 1.0], "buckets": [1, 2, 4]},   # non-empty
+        {"name": "g", "kind": "gauge", "desc": "g help",
+         "tags": {"lbl": weird}, "value": 4.25,
+         "count": 0, "sum": 0.0, "buckets": None},
+        # degraded histogram (decl lost): one +Inf bucket only
+        {"name": "d", "kind": "histogram", "desc": "",
+         "tags": {}, "value": 0.0, "count": 3, "sum": 0.3,
+         "boundaries": [], "buckets": [3]},
+    ]
+    text = render_prometheus(metrics)
+    types, helps, samples = _parse_prom(text)
+    assert types["h"] == ["histogram"], "TYPE must appear exactly once"
+    assert types["g"] == ["gauge"]
+    assert types["d"] == ["histogram"]
+    assert helps["h"] == ["h help"], "HELP from the series that carries it"
+    for tag, count in (("x", 6), ("y", 7)):
+        rows = [s for s in samples
+                if s[0] == "h_bucket" and s[1].get("m") == tag]
+        infs = [v for _, lbl, v in rows if lbl["le"] == "+Inf"]
+        assert infs == [float(count)], (
+            f"+Inf bucket must equal _count for m={tag}: {rows}")
+        # cumulative: non-decreasing in boundary order
+        vals = [v for _, _, v in rows]
+        assert vals == sorted(vals)
+        cnt = [v for n, lbl, v in samples
+               if n == "h_count" and lbl.get("m") == tag]
+        assert cnt == [float(count)]
+    d_inf = [v for n, lbl, v in samples
+             if n == "d_bucket" and lbl["le"] == "+Inf"]
+    assert d_inf == [3.0]
+    g = [s for s in samples if s[0] == "g"]
+    assert g and g[0][1]["lbl"] == weird, "label escaping must round-trip"
+    assert g[0][2] == 4.25
+
+
+def test_list_api_truncation_markers(ray_start_2cpu):
+    @ray_tpu.remote
+    def t(i):
+        return i
+
+    ray_tpu.get([t.remote(i) for i in range(4)], timeout=60)
+    refs = [ray_tpu.put(b"x" * (1 << 20)) for _ in range(3)]
+    time.sleep(0.5)  # event batches drain
+
+    full = state.list_tasks()
+    assert full.truncated is False
+    clipped = state.list_tasks(limit=2)
+    assert clipped.truncated is True and len(clipped) == 2
+    objs = state.list_objects(limit=1)
+    assert objs.truncated is True and len(objs) == 1
+    assert state.list_objects().truncated is False
+    assert state.list_traces().truncated is False
+    profs = state.list_profiles()
+    assert profs == [] and profs.truncated is False
+    del refs
+
+
+def test_profile_worker_cpu_end_to_end(ray_start_2cpu):
+    """`profile_worker` on a busy worker: non-empty collapsed stacks
+    naming the hot method, persisted under <session>/profiles/, listed in
+    the registry, and fetchable through /api/profiles."""
+    import os
+    import urllib.request
+
+    @ray_tpu.remote
+    class Busy:
+        def spin(self, seconds):
+            t0 = time.time()
+            x = 0
+            while time.time() - t0 < seconds:
+                x += 1
+            return x
+
+    a = Busy.remote()
+    ref = a.spin.remote(8.0)
+    time.sleep(0.5)  # the call is executing
+    w = ray_tpu._private.worker.global_worker()
+    info = w.io.run(w.controller.call(
+        "get_actor_info", actor_id=a._actor_id, wait=True))
+    rep = w.io.run(w.controller.call(
+        "profile_worker", worker_id=info["worker_id"], seconds=1.0,
+        mode="cpu"), timeout=45)
+    assert rep.get("found"), rep
+    meta = rep["profile"]
+    assert meta["samples"] > 10, meta
+    assert "/profiles/" in meta["path"]
+    assert os.path.exists(meta["path"]), meta["path"]
+
+    rows = state.list_profiles()
+    assert any(r["name"] == meta["name"] for r in rows)
+
+    doc = w.io.run(w.controller.call("get_profile", name=meta["name"]),
+                   timeout=30)
+    assert doc["found"]
+    collapsed = doc["collapsed"]
+    assert collapsed, "collapsed stacks empty"
+    assert any("spin" in stack for stack in collapsed), list(collapsed)[:3]
+    assert doc["traceEvents"], "chrome-trace events missing"
+    assert any(ev.get("ph") == "X" and "spin" in ev.get("name", "")
+               for ev in doc["traceEvents"])
+
+    # prefix fetch + dashboard surface
+    from ray_tpu.dashboard import start_dashboard
+
+    d = start_dashboard(port=0)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{d.port}/api/profiles", timeout=10) as r:
+            listing = json.loads(r.read())
+        assert any(p["name"] == meta["name"] for p in listing["profiles"])
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{d.port}/api/profiles?"
+                f"name={meta['name'][:10]}", timeout=10) as r:
+            fetched = json.loads(r.read())
+        assert fetched["found"] and fetched["collapsed"]
+    finally:
+        d.stop()
+    assert ray_tpu.get(ref, timeout=60) > 0
+
+
+def test_profile_unknown_worker_is_attributed(ray_start_2cpu):
+    w = ray_tpu._private.worker.global_worker()
+    rep = w.io.run(w.controller.call(
+        "profile_worker", worker_id="deadbeef" * 4, seconds=0.2), timeout=30)
+    assert rep["found"] is False
+    assert "not found" in rep["error"]
+
+
+def test_top_once_renders(telemetry_cluster, capsys):
+    @ray_tpu.remote
+    def one():
+        return 1
+
+    ray_tpu.get([one.remote() for _ in range(3)], timeout=60)
+    # wait for at least one sample to land
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        if any(r["series"] == "node.cpu" for r in state.timeseries()):
+            break
+        time.sleep(0.3)
+    w = ray_tpu._private.worker.global_worker()
+    addr = f"{w.controller_addr[0]}:{w.controller_addr[1]}"
+    from ray_tpu.scripts.cli import main as cli_main
+
+    assert cli_main(["top", "--once", "--address", addr]) == 0
+    out = capsys.readouterr().out
+    assert "NODE" in out and "CPU%" in out and "HBM" in out
+    assert "ALIVE" in out, out
+    assert "controller:" in out and "loop_lag" in out
+    assert "telemetry idle" not in out
+
+
+def test_timeseries_api_via_dashboard(telemetry_cluster):
+    import urllib.request
+
+    @ray_tpu.remote
+    def one():
+        return 1
+
+    ray_tpu.get(one.remote(), timeout=60)
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        if any(r["series"] == "node.cpu" for r in state.timeseries()):
+            break
+        time.sleep(0.3)
+    from ray_tpu.dashboard import start_dashboard
+
+    d = start_dashboard(port=0)
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{d.port}/api/timeseries?series=node.",
+                timeout=10) as r:
+            rep = json.loads(r.read())
+        kinds = {row["series"] for row in rep["series"]}
+        assert {"node.cpu", "node.mem", "node.rss"} <= kinds, kinds
+        for row in rep["series"]:
+            ts = [p[0] for p in row["points"]]
+            assert ts == sorted(ts)
+        # Prometheus exposition carries the telemetry-era self-metrics too
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{d.port}/metrics", timeout=10) as r:
+            prom = r.read().decode()
+        assert "rt_controller_rpc_seconds_bucket" in prom
+        assert 'rt_controller_table_size{table="objects"}' in prom
+        assert prom.count("# TYPE rt_controller_rpc_seconds histogram") == 1
+    finally:
+        d.stop()
