@@ -245,6 +245,12 @@ def main(smoke: bool = False):
         # RT_TELEMETRY_INTERVAL_S=1 — off is byte-identical (no sampler
         # thread), on must stay under 5% on the task-throughput lane.
         _bench_telemetry_overhead(extra_details)
+        # Serving hot loop (perf-gate input, ISSUE 13): end-to-end SSE
+        # streaming decode through proxy+replica+token-ring vs the SAME
+        # engine isolated in-process — the ratio is the serving tax. The
+        # BENCH_r05 per-token reply path measured ~0.045x; the token-ring
+        # path must hold >= 0.5x under 4 concurrent streaming clients.
+        _bench_serve_decode_e2e(extra_details)
 
     ratios = {k: results[k] / BASELINES[k] for k in BASELINES if k in results}
     # put-GB/s is bounded by this host's memcpy bandwidth (one mandatory
@@ -796,6 +802,150 @@ def _bench_flash_attention(results: dict, details: dict):
 
 
 # ---- LLM continuous-batching decode throughput (single chip) -------------
+def _bench_serve_decode_e2e(details: dict):
+    """End-to-end streaming decode vs isolated engine (smoke only; README
+    "Serving hot loop"): 4 concurrent SSE clients stream greedy
+    generations through proxy -> replica -> token ring, against the same
+    4-way concurrent submit().tokens() drain on an engine living in THIS
+    process. Legs interleave in alternating pairs and the gate rides the
+    ratio of medians (the PR 12 noise-aware estimator's shape): on a
+    1-core box both legs share the machine, so only a sustained shift —
+    the actual serving overhead — moves the ratio."""
+    import json as _json
+    import socket
+    import statistics
+    import threading
+    import urllib.request
+
+    n_clients = 4
+    max_tokens = 96
+    lcfg_kw = dict(vocab_size=384, d_model=64, n_layers=2, n_heads=4,
+                   max_seq=256)
+
+    try:
+        import ray_tpu
+        from ray_tpu import serve
+        from ray_tpu.llm import LLMConfig
+        from ray_tpu.llm.engine import ContinuousEngine, SamplingParams
+        from ray_tpu.llm.openai import build_openai_app
+
+        ray_tpu.init(num_cpus=4)
+        eng = ContinuousEngine(LLMConfig(**lcfg_kw), max_batch=8,
+                               decode_chunk=8)
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        app = build_openai_app(LLMConfig(**lcfg_kw), max_batch=8,
+                               decode_chunk=8)
+        serve.run(app, route_prefix="/", port=port)
+        base = f"http://127.0.0.1:{port}"
+        sse_body = _json.dumps({"prompt": "bench", "max_tokens": max_tokens,
+                                "temperature": 0.0, "stream": True}).encode()
+
+        def engine_clients() -> int:
+            done = [0] * n_clients
+
+            def run(i):
+                toks = eng.submit(
+                    [1, 2, 3], SamplingParams(temperature=0.0,
+                                              max_tokens=max_tokens)).tokens()
+                done[i] = len(toks)
+
+            ts = [threading.Thread(target=run, args=(i,))
+                  for i in range(n_clients)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=300)
+            return sum(done)
+
+        def sse_clients() -> int:
+            done = [0] * n_clients
+
+            def run(i):
+                req = urllib.request.Request(
+                    f"{base}/v1/completions", data=sse_body,
+                    headers={"Content-Type": "application/json"})
+                n = 0
+                with urllib.request.urlopen(req, timeout=300) as r:
+                    for line in r:
+                        line = line.decode().strip()
+                        if not line.startswith("data: "):
+                            continue
+                        if line[6:] == "[DONE]":
+                            break
+                        n += len(_json.loads(line[6:]).get("token_ids", []))
+                done[i] = n
+
+            ts = [threading.Thread(target=run, args=(i,))
+                  for i in range(n_clients)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=300)
+            return sum(done)
+
+        def leg(fn) -> float:
+            t0 = time.perf_counter()
+            total = fn()
+            dt = time.perf_counter() - t0
+            if total < n_clients * max_tokens:
+                raise RuntimeError(
+                    f"leg lost tokens: {total} < {n_clients * max_tokens}")
+            return total / dt
+
+        # Warm BOTH engines (driver-local + replica: prefill bucket, every
+        # greedy chunk program incl. the shrinking tail sizes) before any
+        # timed window — a compile landing inside a leg corrupts it.
+        engine_clients()
+        sse_clients()
+
+        eng_rates: list[float] = []
+        e2e_rates: list[float] = []
+        pairs = 3
+        pair = 0
+        while True:
+            for _ in range(pairs):
+                order = ((True, False) if pair % 2 == 0 else (False, True))
+                for is_eng in order:
+                    (eng_rates if is_eng else e2e_rates).append(
+                        leg(engine_clients if is_eng else sse_clients))
+                pair += 1
+            eng_med = statistics.median(eng_rates)
+            e2e_med = statistics.median(e2e_rates)
+            ratio = e2e_med / max(eng_med, 1e-9)
+            devs = ([abs(r / max(eng_med, 1e-9) - 1.0) for r in eng_rates]
+                    + [abs(r / max(e2e_med, 1e-9) - 1.0) for r in e2e_rates])
+            rel_mad = statistics.median(devs)
+            # 0.5x is the spec'd floor, enforced whenever the box can
+            # resolve it; ambient noise widens it downward the same way
+            # the overhead lanes widen their 1.05x upward.
+            bound = round(min(0.5, 0.5 / (1.0 + 3.0 * rel_mad)), 3)
+            if ratio >= bound or pair >= 2 * pairs:
+                break
+            log(f"  serve_decode_e2e read {ratio:.3f}x over {pair} pairs "
+                f"— extending the measurement window")
+        serve.shutdown()
+        eng.shutdown()
+        ray_tpu.shutdown()
+    except Exception as e:
+        log(f"  serve_decode_e2e skipped: {e}")
+        try:
+            import ray_tpu
+
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        return
+    log(f"  serve_decode_e2e: engine {eng_med:,.0f} tok/s vs end-to-end "
+        f"{e2e_med:,.0f} tok/s ({ratio:.3f}x, {n_clients} SSE clients, "
+        f"median of {pair} interleaved pairs; gate bound {bound:.3f}x)")
+    details["serve_decode_engine_tok_s"] = round(eng_med, 1)
+    details["serve_decode_e2e_tok_s"] = round(e2e_med, 1)
+    details["serve_decode_e2e_ratio"] = round(ratio, 3)
+    details["serve_decode_e2e_bound"] = bound
+
+
 def _bench_llm_decode(results: dict):
     try:
         import jax

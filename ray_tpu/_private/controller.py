@@ -1988,8 +1988,11 @@ class Controller:
             for wid, wseries in (b.get("workers") or {}).items():
                 sub = str(wid)[:12]
                 for series, val in (wseries or {}).items():
-                    self._telem_append((nid, f"worker.{series}", sub),
-                                       ts, val)
+                    # Dotted keys are already fully-qualified series names
+                    # (e.g. the engine's `llm.tokens_per_s`); bare keys
+                    # get the worker. family prefix.
+                    name = series if "." in series else f"worker.{series}"
+                    self._telem_append((nid, name, sub), ts, val)
         self._telem_prune()
 
     def _telem_prune(self) -> None:
@@ -2088,8 +2091,13 @@ class Controller:
             if ent is None:  # series outliving its node entry (death race)
                 continue
             if sub:
-                ent["workers"].setdefault(sub, {})[
-                    series.split(".", 1)[1]] = last[1]
+                # worker.-family series drop the prefix ("worker.cpu" ->
+                # "cpu"); fully-qualified dotted series (the engine's
+                # "llm.tokens_per_s") keep their name — `ray-tpu top`
+                # reads them by it.
+                key = (series.split(".", 1)[1]
+                       if series.startswith("worker.") else series)
+                ent["workers"].setdefault(sub, {})[key] = last[1]
             else:
                 ent["node"][series.split(".", 1)[1]] = last[1]
             age = round(time.time() - ring.last_ts, 3)

@@ -226,6 +226,22 @@ _flag("profile_hz", int, 100)
 # Storage-plane URI captured profiles persist under (any backend);
 # "" = <session_dir>/<session>/profiles.
 _flag("profile_dir", str, "")
+# --- serving hot loop (README "Serving hot loop") ---------------------------
+# Token-batch stream ring: streaming serve responses (SSE) ride a shm
+# StreamRing from the replica straight to the HTTP proxy — one host hop
+# per token BATCH instead of one ObjectRef round trip per token. False
+# restores the per-item streaming-generator reply path byte-identically
+# (pinned by test).
+_flag("token_ring", bool, True)
+# Per-stream ring capacity in bytes (bounded: a stalled SSE consumer
+# parks the producer instead of buffering unboundedly; a record may be at
+# most half this).
+_flag("token_ring_bytes", int, 1 << 20)
+# Continuous-engine prefill lane: admissions (bucketed prefill + first-
+# token sample) dispatch on a dedicated thread and splice into the
+# running batch at chunk boundaries, so a new request's prefill compile/
+# dispatch never stalls the decode loop. False restores inline admission.
+_flag("llm_prefill_lane", bool, True)
 # --- kernels / diagnostics --------------------------------------------------
 # Decode-attention kernel selection: "pallas" / "xla" force a path, ""
 # keeps the size-based dispatch (ops/decode_attention.py

@@ -285,6 +285,17 @@ class WorkerSampler:
                 out["device_bytes"] = int(st.get("bytes") or 0)
             except Exception:
                 pass
+        eng = sys.modules.get("ray_tpu.llm.engine")
+        if eng is not None:
+            # Live decode throughput (README "Serving hot loop"): tokens
+            # delivered to stream consumers since the previous tick. Only
+            # workers that actually host a continuous engine ever import
+            # the module, so everyone else skips the series entirely.
+            try:
+                out["llm.tokens_per_s"] = round(
+                    eng.tokens_per_s_snapshot(), 2)
+            except Exception:
+                pass
         return out
 
 

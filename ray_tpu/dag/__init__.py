@@ -30,6 +30,7 @@ import uuid
 from typing import Any, Optional
 
 import ray_tpu
+from ray_tpu.dag.stream import RingClosed, StreamRing  # noqa: F401 (re-export)
 from ray_tpu.experimental.channel import Channel
 from ray_tpu.workflow import DAGNode
 

@@ -21,10 +21,20 @@ engine workers via vllm_models.py:123-137). TPU-native design:
   sampling params and PRNG key), so mixed request settings share a batch.
 - **TP over a mesh**: pass `mesh` (axis "tp") and params/caches shard via
   the model's Megatron PartitionSpecs; XLA inserts the ICI collectives.
+- **Zero-sync hot loop** (README "Serving hot loop"): decode chunks stay
+  pipelined on device with their inputs chained through device-resident
+  mirrors; each chunk's token block starts its device→host copy at
+  dispatch (`copy_to_host_async`) and is read back one chunk per
+  iteration while every younger chunk keeps executing — the XLA stream
+  never drains on a readback. Prefill dispatches on its own lane thread
+  and splices into the batch at chunk boundaries, so admissions never
+  stall steady-state decode. Tokens are DELIVERED in per-chunk batches
+  (one consumer wakeup per chunk, not per token).
 """
 
 from __future__ import annotations
 
+import collections
 import itertools
 import logging
 import queue
@@ -36,8 +46,37 @@ from typing import Any, Optional
 import numpy as np
 
 from ray_tpu._private import tracing as _tracing
+from ray_tpu._private.rtconfig import CONFIG
 
 logger = logging.getLogger(__name__)
+
+#: Cumulative tokens delivered to GenStream consumers across every engine
+#: in this process — the `llm.tokens_per_s` telemetry series' source
+#: (telemetry.WorkerSampler reads the per-tick rate via
+#: tokens_per_s_snapshot; sys.modules-gated, so jax-free workers never
+#: import this module for it).
+_tok_lock = threading.Lock()
+_tok_count = 0
+_tok_rate_state: list = [None, 0]  # [last snapshot monotonic, last count]
+
+
+def _count_tokens(n: int) -> None:
+    global _tok_count
+    with _tok_lock:
+        _tok_count += n
+
+
+def tokens_per_s_snapshot() -> float:
+    """Decode-throughput rate since the previous snapshot (telemetry tick
+    cadence). First call anchors the window and reports 0."""
+    with _tok_lock:
+        c = _tok_count
+    now = time.monotonic()
+    t0, c0 = _tok_rate_state
+    _tok_rate_state[0], _tok_rate_state[1] = now, c
+    if t0 is None or now <= t0:
+        return 0.0
+    return (c - c0) / (now - t0)
 
 
 @dataclass
@@ -56,7 +95,14 @@ class SamplingParams:
 class GenStream:
     """Host-side token stream of one request: iterate to receive token ids
     as the engine emits them; ends with StopIteration (or raises the
-    engine's error)."""
+    engine's error).
+
+    Delivery is BATCHED: the engine enqueues one token-id list per decode
+    chunk, so a blocked reader wakes once per chunk. `next_batch()`
+    exposes the batches directly — it drains every token currently
+    available in one call (the serve SSE path coalesces such a batch into
+    a single flush); `__next__`/`next()` keep the one-token-at-a-time
+    surface on top of the same queue."""
 
     _DONE = object()
 
@@ -64,12 +110,14 @@ class GenStream:
         self.request_id = request_id
         self.prompt_len = prompt_len
         self._q: "queue.Queue" = queue.Queue()
+        self._buf: collections.deque = collections.deque()
+        self._exc: Optional[Exception] = None  # deferred: tokens first
         self.finish_reason: Optional[str] = None
         self.closed = False
         # Trace context captured at submit (README "Tracing & timeline"):
         # the engine scheduler thread parents its per-iteration spans —
         # prefill, chunk dispatch, host-sync readback — to the submitting
-        # request's trace, making each per-token host round trip visible.
+        # request's trace, making each per-chunk host round trip visible.
         self.trace: Optional[tuple] = None
 
     def close(self):
@@ -81,18 +129,32 @@ class GenStream:
     def __iter__(self):
         return self
 
+    def _pop(self, timeout: Optional[float] = None):
+        """One token; blocks on the batch queue. Raises StopIteration at
+        end of stream, queue.Empty on timeout, or the engine's error."""
+        while True:
+            if self._buf:
+                return self._buf.popleft()
+            if self._exc is not None:
+                exc, self._exc = self._exc, None
+                raise exc
+            item = self._q.get(timeout=timeout)
+            if item is GenStream._DONE:
+                self._q.put(GenStream._DONE)  # idempotent re-next
+                raise StopIteration
+            if isinstance(item, Exception):
+                raise item
+            if isinstance(item, list):
+                self._buf.extend(item)
+            else:
+                return item
+
     def __next__(self):
-        item = self._q.get()
-        if item is GenStream._DONE:
-            self._q.put(GenStream._DONE)  # idempotent re-next
-            raise StopIteration
-        if isinstance(item, Exception):
-            raise item
-        return item
+        return self._pop()
 
     def next(self, timeout: Optional[float] = None):
         try:
-            item = self._q.get(timeout=timeout)
+            return self._pop(timeout=timeout)
         except queue.Empty:
             from ray_tpu.exceptions import GetTimeoutError
 
@@ -101,12 +163,31 @@ class GenStream:
             raise GetTimeoutError(
                 f"request {self.request_id} yielded no token within "
                 f"{timeout}s") from None
-        if item is GenStream._DONE:
-            self._q.put(GenStream._DONE)
-            raise StopIteration
-        if isinstance(item, Exception):
-            raise item
-        return item
+
+    def next_batch(self, timeout: Optional[float] = None) -> list[int]:
+        """Every token currently available, blocking only for the first:
+        one reader wakeup drains the whole burst (the engine enqueues one
+        batch per decode chunk). Raises StopIteration at end of stream and
+        GetTimeoutError when nothing arrives in time."""
+        out = [self.next(timeout=timeout)]
+        while True:
+            if self._buf:
+                out.append(self._buf.popleft())
+                continue
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                return out
+            if item is GenStream._DONE:
+                self._q.put(GenStream._DONE)  # next call raises Stop
+                return out
+            if isinstance(item, Exception):
+                self._exc = item  # tokens in hand first; raise next call
+                return out
+            if isinstance(item, list):
+                self._buf.extend(item)
+            else:
+                out.append(item)
 
     def tokens(self) -> list[int]:
         """Drain the stream to completion."""
@@ -222,10 +303,28 @@ class ContinuousEngine:
         self._cooling: dict[int, Any] = {}
         self._toks_dev = jnp.zeros(max_batch, jnp.int32)
         self._lens_dev = jnp.zeros(max_batch, jnp.int32)
+        # Every GenStream not yet _DONE, independent of slot state: the
+        # scheduler-death safety net terminates these with an attributed
+        # error even when the slot table itself is the casualty.
+        self._streams: set = set()
         self._running = True
+        # Prefill lane (README "Serving hot loop"): admissions dispatch on
+        # their own thread and splice at chunk boundaries via _ready, so a
+        # prefill compile/dispatch never blocks the decode loop. Off =
+        # inline admission in the scheduler loop (the classic path).
+        self._prefill_lane = bool(CONFIG.llm_prefill_lane)
+        self._ready: collections.deque = collections.deque()
+        self._prefill_inflight = 0
+        self._threads = []
+        if self._prefill_lane:
+            t = threading.Thread(target=self._prefill_loop, daemon=True,
+                                 name="rt-llm-prefill")
+            t.start()
+            self._threads.append(t)
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name="rt-llm-engine")
         self._thread.start()
+        self._threads.append(self._thread)
 
     # ------------------------------------------------------------ sharding
     def _shard_params(self, params, mesh):
@@ -367,6 +466,7 @@ class ContinuousEngine:
         with self._lock:
             if not self._running:
                 raise RuntimeError("engine is shut down")
+            self._streams.add(stream)
             self._pending.put((prompt, sampling, stream))
             self._lock.notify_all()
         return stream
@@ -381,19 +481,45 @@ class ContinuousEngine:
         with self._lock:
             self._running = False
             self._lock.notify_all()
-        self._thread.join(timeout=10)
+        self._pending.put(None)  # wake the prefill lane past its get()
+        for t in self._threads:
+            t.join(timeout=10)
         # Belt and braces after the join: the scheduler thread drains
         # _pending on exit, but if the join timed out (thread wedged in a
         # device call) any queued streams would hang their consumers —
         # terminate them here. Safe against the loop's own drain (done
         # markers are idempotent) because no new submit can enqueue after
         # the flag flipped under the lock.
+        self._drain_all_streams()
+
+    def _drain_all_streams(self, error: Optional[Exception] = None):
+        """Terminate every stream that has not seen _DONE: queued, ready,
+        slotted, or otherwise tracked. Idempotent (done markers re-queue
+        harmlessly); the error, when given, lands before the marker."""
         while True:
             try:
-                _p, _s, stream = self._pending.get_nowait()
+                item = self._pending.get_nowait()
             except queue.Empty:
                 break
+            if item is None:
+                continue
+            _p, _s, stream = item
+            self._finish_stream(stream, error)
+        with self._lock:
+            streams = list(self._streams)
+            self._streams.clear()
+        for stream in streams:
+            if error is not None:
+                stream._q.put(error)
             stream._q.put(GenStream._DONE)
+
+    def _finish_stream(self, stream: GenStream,
+                       error: Optional[Exception] = None):
+        if error is not None:
+            stream._q.put(error)
+        stream._q.put(GenStream._DONE)
+        with self._lock:
+            self._streams.discard(stream)
 
     @property
     def num_active(self) -> int:
@@ -406,19 +532,18 @@ class ContinuousEngine:
             b *= 2
         return min(b, self.cfg.max_seq)
 
-    def _admit_async(self, slot: int, prompt, sampling, stream):
-        """Dispatch prefill + first-token sample + cache place for one slot
-        WITHOUT reading the result back (the caller batches the host reads
-        of a whole admission wave into one device sync — each read is a
-        full round trip on tunneled/remote TPUs)."""
+    def _prefill_dispatch(self, prompt, sampling, stream):
+        """Dispatch bucketed prefill + first-token sample WITHOUT reading
+        anything back: returns (first_token_dev, cache_slice, next_key) —
+        pure device handles, safe to produce off the scheduler thread (no
+        shared scheduler state is touched)."""
         import jax.numpy as jnp
 
         plen = len(prompt)
         lb = self._bucket(plen)
         toks = np.zeros((1, lb), np.int32)
         toks[0, :plen] = prompt
-        if self._cache is None:
-            self._cache = self._init_cache()
+        t_adm = time.time()
         last_logits, cache_slice = self._prefill(
             self.params, jnp.asarray(toks), plen)
         key = self._jax.random.fold_in(
@@ -427,6 +552,65 @@ class ContinuousEngine:
             last_logits, key,
             jnp.float32(sampling.temperature),
             jnp.int32(sampling.top_k), jnp.float32(sampling.top_p))
+        _tracing.record_span_in(
+            stream.trace, "engine.prefill", "engine", t_adm, time.time(),
+            {"prompt_len": plen})
+        return first, cache_slice, self._jax.random.fold_in(key, 1)
+
+    def _prefill_loop(self):
+        """The prefill lane: drains submits, dispatches their prefills,
+        and parks the device-resident results in _ready for the scheduler
+        to splice at the next chunk boundary. Prefill COMPILES (new
+        buckets) and dispatches happen here — the decode loop never
+        stalls for an admission."""
+        while True:
+            try:
+                item = self._pending.get(timeout=0.25)
+            except queue.Empty:
+                if not self._running:
+                    return
+                continue
+            if item is None:  # shutdown wakeup
+                if not self._running:
+                    return
+                continue
+            prompt, sampling, stream = item
+            if not self._running:
+                # Shutdown raced the pop: terminate the stream instead of
+                # compiling/dispatching a prefill nobody will consume (a
+                # cold bucket compile here would stall shutdown's join).
+                self._finish_stream(stream)
+                continue
+            if stream.closed:
+                stream.finish_reason = "cancelled"
+                self._finish_stream(stream)
+                continue
+            # inflight guards the scheduler's idle-wait: a popped submit
+            # whose prefill is still dispatching must keep the loop from
+            # concluding "nothing pending" (it would only cost the 0.1s
+            # wait timeout, but the first token is latency-critical).
+            with self._lock:
+                self._prefill_inflight += 1
+            try:
+                entry = (len(prompt), sampling, stream,
+                         *self._prefill_dispatch(prompt, sampling, stream))
+            except Exception as e:  # bad request or device failure
+                with self._lock:
+                    self._prefill_inflight -= 1
+                self._finish_stream(stream, e)
+                continue
+            with self._lock:
+                self._ready.append(entry)
+                self._prefill_inflight -= 1
+                self._lock.notify_all()
+
+    def _splice(self, slot: int, plen: int, sampling, stream, first,
+                cache_slice, key):
+        """Install one prefilled request into batch row `slot` (scheduler
+        thread only — this is the chunk-boundary splice point): scatter
+        the cache slice, set the device mirrors, book the slot."""
+        if self._cache is None:
+            self._cache = self._init_cache()
         self._cache = self._place(self._cache, cache_slice,
                                   self._jnp.int32(slot))
         st = _Slot(stream, sampling)
@@ -437,34 +621,64 @@ class ContinuousEngine:
         self._temps_dev = self._temps_dev.at[slot].set(sampling.temperature)
         self._topks_dev = self._topks_dev.at[slot].set(sampling.top_k)
         self._topps_dev = self._topps_dev.at[slot].set(sampling.top_p)
-        self._keys = self._keys.at[slot].set(self._jax.random.fold_in(
-            key, 1))
-        return first  # device scalar
+        self._keys = self._keys.at[slot].set(key)
+        self._pending_firsts.append((slot, first))
+        # Merge into the device mirrors without a sync.
+        self._toks_dev = self._toks_dev.at[slot].set(first)
+        self._lens_dev = self._lens_dev.at[slot].set(int(plen))
 
-    def _emit(self, slot: int, tok: int):
+    def _admit_async(self, slot: int, prompt, sampling, stream):
+        """Inline admission (prefill lane off): dispatch prefill + first-
+        token sample + cache place for one slot WITHOUT reading the result
+        back (first tokens join the next drain's readback — each read is a
+        full round trip on tunneled/remote TPUs)."""
+        first, cache_slice, key = self._prefill_dispatch(
+            prompt, sampling, stream)
+        self._splice(slot, len(prompt), sampling, stream, first,
+                     cache_slice, key)
+
+    def _free_slot(self, taken=()) -> Optional[int]:
+        return next((i for i, s in enumerate(self._slots)
+                     if s is None and i not in self._cooling
+                     and i not in taken), None)
+
+    def _deliver(self, slot: int, toks: list):
+        """Hand one chunk's tokens for `slot` to its stream as ONE queue
+        put (a blocked reader wakes once per chunk, not once per token),
+        applying stop-token / length truncation host-side."""
         st = self._slots[slot]
+        if st is None:
+            return
         if st.stream.closed:
             st.stream.finish_reason = "cancelled"
             self._retire(slot)
             return
-        st.stream._q.put(int(tok))
-        st.emitted += 1
-        st.remaining -= 1
+        out = toks[:max(0, st.remaining)]
+        finish = None
         stop = st.sampling.stop_token
-        if st.remaining <= 0 or (stop is not None and tok == stop):
-            st.stream.finish_reason = (
-                "stop" if (stop is not None and tok == stop) else "length")
+        if stop is not None and stop in out:
+            out = out[:out.index(stop) + 1]
+            finish = "stop"
+        st.emitted += len(out)
+        st.remaining -= len(out)
+        if finish is None and st.remaining <= 0:
+            finish = "length"
+        if out:
+            st.stream._q.put(out)
+            _count_tokens(len(out))
+        if finish is not None:
+            st.stream.finish_reason = finish
             self._retire(slot)
 
     def _retire(self, slot: int):
         st = self._slots[slot]
-        st.stream._q.put(GenStream._DONE)
+        self._finish_stream(st.stream)
         self._slots[slot] = None
         self._n_active -= 1
         self._lengths[slot] = 0
         self._next_tok[slot] = 0
         # (device-side sampling mirrors keep stale values for retired
-        # slots; the slot decodes garbage that emit discards)
+        # slots; the slot decodes garbage that deliver discards)
         if self._q_chunks and slot in self._q_chunks[-1][1]:
             # Already-dispatched chunks still step this slot; it must not
             # be re-admitted until the NEWEST of them is emitted (device
@@ -473,60 +687,98 @@ class ContinuousEngine:
             self._cooling[slot] = self._q_chunks[-1][3]
 
     def _loop(self):
+        """Scheduler wrapper: an unexpected scheduler death must surface
+        an attributed error on EVERY open stream (queued, ready, or
+        decoding) — a consumer blocked in next() can never hang on a dead
+        engine. Normal exit drains the same way without the error."""
+        error: Optional[Exception] = None
+        try:
+            self._run_scheduler()
+        except Exception as e:  # noqa: BLE001 - terminal: loop is dead
+            logger.exception("llm engine scheduler loop died")
+            error = RuntimeError(f"llm engine scheduler died: {e!r}")
+        finally:
+            with self._lock:
+                self._running = False
+            self._drain_all_streams(error)
+
+    def _run_scheduler(self):
         """Scheduler with depth-D software pipelining. Host syncs are the
         scarce resource (a tunneled/remote TPU pays ~100ms per blocking
         read): up to `pipeline_depth` decode chunks stay in flight with
         their inputs chained ENTIRELY on device (next-token/length mirrors
-        ride chunk outputs, so steady-state dispatch transfers nothing),
-        and token readbacks happen one chunk per iteration — each read
-        overlaps the execution of every younger in-flight chunk.
-        Correctness leans on device program order (place/chunk chain
-        through the cache handle); the host only avoids re-admitting a
-        slot an in-flight chunk still steps (the _cooling set)."""
+        ride chunk outputs, so steady-state dispatch transfers nothing).
+        Each chunk's token block starts its device→host copy AT DISPATCH
+        (copy_to_host_async) and is read back one chunk per iteration —
+        double-buffered extraction: reading chunk N overlaps the execution
+        of chunks N+1..N+D-1, so the XLA stream never drains. Correctness
+        leans on device program order (place/chunk chain through the cache
+        handle); the host only avoids re-admitting a slot an in-flight
+        chunk still steps (the _cooling set)."""
         import jax.numpy as jnp
 
         while self._running:
-            # ---- 1. admissions (batched: ONE device sync per wave)
-            admits = []
-            while (self._n_active + len(admits)) < self.max_batch:
-                free = next((i for i, s in enumerate(self._slots)
-                             if s is None and i not in self._cooling
-                             and all(i != a[0] for a in admits)), None)
-                if free is None:
-                    break
-                try:
-                    prompt, sampling, stream = self._pending.get_nowait()
-                except queue.Empty:
-                    break
-                try:
-                    t_adm = time.time()
-                    first_dev = self._admit_async(free, prompt, sampling,
-                                                  stream)
-                    _tracing.record_span_in(
-                        stream.trace, "engine.prefill", "engine", t_adm,
-                        time.time(),
-                        {"slot": free, "prompt_len": len(prompt)})
-                    admits.append((free, first_dev))
-                    # Merge into the device mirrors without a sync.
-                    self._toks_dev = self._toks_dev.at[free].set(first_dev)
-                    self._lens_dev = self._lens_dev.at[free].set(
-                        int(self._lengths[free]))
-                except Exception as e:  # bad request or engine failure
-                    stream._q.put(e)
-                    stream._q.put(GenStream._DONE)
-            # First tokens are NOT read here: they join the next drain's
-            # single sync (an admission-wave readback would cost its own
-            # ~100ms round trip on tunneled TPUs).
-            self._pending_firsts.extend(admits)
-            if self._n_active == 0 and not self._q_chunks:
+            # ---- 1. admissions: splice prefilled requests at the chunk
+            # boundary (prefill lane), or run the classic inline admission
+            # (lane off). Either way nothing here reads from device.
+            if self._prefill_lane:
+                while self._n_active < self.max_batch:
+                    free = self._free_slot()
+                    if free is None:
+                        break
+                    with self._lock:
+                        if not self._ready:
+                            break
+                        entry = self._ready.popleft()
+                    plen, sampling, stream, first, cache_slice, key = entry
+                    if stream.closed:
+                        stream.finish_reason = "cancelled"
+                        self._finish_stream(stream)
+                        continue
+                    try:
+                        self._splice(free, plen, sampling, stream, first,
+                                     cache_slice, key)
+                    except Exception as e:
+                        self._finish_stream(stream, e)
+            else:
+                while self._n_active < self.max_batch:
+                    free = self._free_slot()
+                    if free is None:
+                        break
+                    try:
+                        item = self._pending.get_nowait()
+                    except queue.Empty:
+                        break
+                    if item is None:
+                        continue
+                    prompt, sampling, stream = item
+                    try:
+                        self._admit_async(free, prompt, sampling, stream)
+                    except Exception as e:  # bad request or engine failure
+                        self._finish_stream(stream, e)
+            # First tokens are NOT read at admission: they join the next
+            # drain's readback (an admission-wave readback would cost its
+            # own ~100ms round trip on tunneled TPUs).
+            if (self._n_active == 0 and not self._q_chunks
+                    and not self._pending_firsts):
                 with self._lock:
-                    if self._pending.empty() and self._running:
+                    if (self._running and self._pending.empty()
+                            and not self._ready
+                            and self._prefill_inflight == 0):
                         self._lock.wait(timeout=0.1)
                 continue
             # ---- 2. fill the pipeline: dispatch up to pipeline_depth
             # chunks back to back (dispatches are asynchronous and nearly
             # free; only the readback costs a round trip)
             while len(self._q_chunks) < self.pipeline_depth:
+                if (self._prefill_lane and self._ready
+                        and self._n_active < self.max_batch
+                        and self._free_slot() is not None):
+                    # A prefilled request is waiting and a slot is open:
+                    # stop filling the pipeline with the OLD batch and
+                    # splice at this chunk boundary (next iteration's
+                    # admission step) — join latency stays a few tokens.
+                    break
                 active = [i for i, s in enumerate(self._slots)
                           if s is not None]
                 if not active:
@@ -561,6 +813,14 @@ class ContinuousEngine:
                             self._toks_dev, self._lens_dev,
                             self._keys, self._temps_dev,
                             self._topks_dev, self._topps_dev, n, greedy)
+                    # Start the device→host copy of this chunk's tokens
+                    # NOW: by the time the drain reads it (D iterations
+                    # later), the transfer has overlapped the younger
+                    # chunks' execution instead of serializing after it.
+                    try:
+                        toks_out.copy_to_host_async()
+                    except Exception:
+                        pass  # backend without async copy: read pays it
                     _tracing.record_span_in(
                         tctx, "engine.dispatch_chunk", "engine", t_disp,
                         time.time(), {"tokens": n, "active": len(active)})
@@ -578,11 +838,14 @@ class ContinuousEngine:
                         self._slots[i].stream._q.put(e)
                         self._retire(i)
                     break
-            # ---- 3. drain: read the admission wave's first tokens AND
-            # every queued chunk in ONE device sync (a concatenated
-            # transfer costs the same round trip as one chunk's worth)
+            # ---- 3. drain: read the OLDEST in-flight chunk (plus any
+            # admission wave's first tokens) in one device sync, leaving
+            # the younger chunks executing — the double buffer. One
+            # host_sync per chunk: a request's span count is bounded by
+            # its CHUNK count, never its token count.
             if self._q_chunks or self._pending_firsts:
-                q, self._q_chunks = self._q_chunks, []
+                q = self._q_chunks[:1]
+                del self._q_chunks[:1]
                 firsts, self._pending_firsts = self._pending_firsts, []
                 parts = []
                 if firsts:
@@ -593,8 +856,9 @@ class ContinuousEngine:
                 parts.extend(c[0] for c in q)
                 # The host-sync readback: THE per-iteration host-link round
                 # trip the decode loop pays (the 22x end-to-end gap in
-                # BENCH_r05 is made of these). Span it against the oldest
-                # traced in-flight request + the decode-step histogram.
+                # BENCH_r05 was made of these, one per TOKEN; now one per
+                # chunk, overlapped). Span it against the oldest traced
+                # in-flight request + the decode-step histogram.
                 sync_ctx = None
                 if _tracing.enabled():
                     sync_ctx = next(
@@ -644,7 +908,7 @@ class ContinuousEngine:
                         if self._slots[slot] is None:
                             continue  # retired by a failed-dispatch path
                         self._next_tok[slot] = int(all_np[slot, 0])
-                        self._emit(slot, int(all_np[slot, 0]))
+                        self._deliver(slot, [int(all_np[slot, 0])])
                 if firsts:
                     off = 1
                 for _toks_dev, p_active, pn, tag in q:
@@ -654,23 +918,12 @@ class ContinuousEngine:
                                 0, self._pending_toks[i] - pn)
                             if self._slots[i] is None:
                                 continue  # retired; tail is garbage
-                            for j in range(off, off + pn):
-                                if self._slots[i] is None:
-                                    break
-                                self._emit(i, int(all_np[i, j]))
+                            toks = [int(all_np[i, j])
+                                    for j in range(off, off + pn)]
+                            self._deliver(i, toks)
                             if self._slots[i] is not None:
                                 self._next_tok[i] = int(
                                     all_np[i, off + pn - 1])
                     off += pn
                     self._cooling = {s: t for s, t in self._cooling.items()
                                      if t is not tag}
-        # drain on shutdown
-        for i, s in enumerate(self._slots):
-            if s is not None:
-                s.stream._q.put(GenStream._DONE)
-        while True:
-            try:
-                _p, _s, stream = self._pending.get_nowait()
-            except queue.Empty:
-                break
-            stream._q.put(GenStream._DONE)

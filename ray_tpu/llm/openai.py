@@ -11,6 +11,7 @@ prompts are strings (byte-level tokenizer) or raw token lists.
 from __future__ import annotations
 
 import json
+import os
 import time
 from typing import Any, Optional
 
@@ -97,6 +98,11 @@ class OpenAIServer:
             return {"object": "list",
                     "data": [{"id": self.model_id, "object": "model",
                               "owned_by": "ray_tpu"}]}
+        if path.endswith("/v1/stats") or path.endswith("/stats"):
+            # Introspection for chaos tests / ops: which process hosts the
+            # engine and how many slots are live (a leaked slot shows here).
+            return {"pid": os.getpid(), "active": self.engine.num_active,
+                    "running": self.engine._running}
         body = request.json() or {}
         chat = "chat" in path or "messages" in body
         prompt = self._encode_prompt(body)
@@ -110,14 +116,19 @@ class OpenAIServer:
             req_id, self.tok.decode(toks), toks, stream.finish_reason, chat)
 
     def _stream_chunks(self, req_id: str, stream: GenStream, chat: bool):
-        """Generator of OpenAI SSE chunk dicts — one per token, as the
-        engine emits them (rides the core streaming-generator transport
-        through the replica/proxy)."""
+        """Generator of OpenAI SSE chunk dicts — one per token BATCH
+        (GenStream.next_batch drains every token available per wakeup, so
+        a chunk of decode output is one dict, one downstream flush — not
+        one wakeup and one SSE event per token)."""
         def gen():
             try:
-                for tok in stream:
+                while True:
+                    try:
+                        toks = stream.next_batch()
+                    except StopIteration:
+                        break
                     yield self._completion_body(
-                        req_id, self.tok.decode([tok]), [tok], None, chat,
+                        req_id, self.tok.decode(toks), toks, None, chat,
                         stream_delta=True)
                 yield self._completion_body(
                     req_id, "", [], stream.finish_reason or "length", chat,

@@ -36,6 +36,13 @@ class Proxy:
         self._started = False
         self._resolver = None
         self._stream_pool = None  # dedicated: SSE waits pin a thread each
+        # deployment -> monotonic time of its last ring-handshake nak: a
+        # peer that cannot attach (cross-host replica, no shared shm)
+        # naks every request, so skip the 1MB ring setup/unlink for a
+        # while instead of paying it per stream. Time-bounded (not
+        # permanent) so a transient failure can't disable the ring path
+        # for a deployment forever.
+        self._ring_nak: dict[str, float] = {}
 
     async def ready(self) -> int:
         """Bind the HTTP server; returns the bound port."""
@@ -192,17 +199,106 @@ class Proxy:
             _tracing.end_request(trh, f"http {request.method} {request.path}",
                                  {"deployment": dep})
 
+    @staticmethod
+    def _sse_chunk(item) -> bytes:
+        if isinstance(item, bytes):
+            data = item.decode("utf-8", "replace")
+        elif isinstance(item, str):
+            data = item
+        else:
+            data = json.dumps(item)
+        return f"data: {data}\n\n".encode()
+
+    async def _stream_from_ring(self, resp, ring, gen, loop):
+        """Token-ring reply path (README "Serving hot loop"): drain item
+        batches from the shm ring — ONE reader wakeup and ONE socket flush
+        per burst, however many tokens it carries — until the producer's
+        end/err record. Replica death is detected via the stream task's
+        completion ref, so a dead producer surfaces an attributed error
+        within the resolver's poll cadence instead of hanging the SSE."""
+        from ray_tpu.dag.stream import RingClosed
+
+        cfut = self._resolver.submit(gen.completed())
+        # Consume the exception if the response path never does (a stream
+        # that ended via its "end" record before the death raced in).
+        cfut.add_done_callback(
+            lambda f: f.cancelled() or f.exception())
+        completed_grace = False
+        while True:
+            try:
+                batch = await loop.run_in_executor(
+                    self._stream_pool,
+                    lambda: ring.read_batch(timeout=0.25))
+            except TimeoutError:
+                if cfut.done():
+                    exc = cfut.exception()
+                    if exc is not None:
+                        raise exc  # replica died mid-stream: attributed
+                    if completed_grace:
+                        # Task finished, ring drained, no end record (the
+                        # producer was interrupted between its last item
+                        # and the end marker): finish cleanly.
+                        break
+                    completed_grace = True
+                continue
+            except RingClosed:
+                break
+            buf = bytearray()
+            done = False
+            for rec in batch:
+                kind = rec[0]
+                if kind == "item":
+                    buf += self._sse_chunk(rec[1])
+                elif kind == "end":
+                    done = True
+                elif kind == "err":
+                    buf += self._sse_chunk({"error": rec[1]})
+                    done = True
+            if buf:
+                await resp.write(bytes(buf))  # coalesced: one flush/burst
+            if done:
+                break
+        await resp.write(b"data: [DONE]\n\n")
+        await resp.write_eof()
+
     async def _handle_streaming(self, request, req, router, model_id, loop):
-        """SSE response: one `data:` event per streamed item, then [DONE]."""
+        """SSE response: one `data:` event per streamed item, then [DONE].
+        With the token ring armed (RT_TOKEN_RING, default on) items ride a
+        per-request shm StreamRing from the replica — one host hop per
+        item BATCH — and multi-item arrivals coalesce into single socket
+        flushes; RT_TOKEN_RING=0 keeps the classic one-ObjectRef-per-item
+        reply path byte-identically."""
         from aiohttp import web
 
+        from ray_tpu._private.rtconfig import CONFIG
+
+        ring = None
+        ring_spec = None
+        if CONFIG.token_ring and (
+                loop.time() - self._ring_nak.get(router.deployment, -1e9)
+                > 60.0):
+            try:
+                import uuid
+
+                from ray_tpu.dag.stream import StreamRing
+
+                ring = StreamRing(f"sse_{uuid.uuid4().hex[:12]}",
+                                  int(CONFIG.token_ring_bytes))
+                ring_spec = ring.spec()
+            except Exception as e:
+                logger.debug("token ring unavailable (%r): classic path", e)
+                ring = None
+                ring_spec = None
         try:
             pctx = contextvars.copy_context()  # carry the trace context
             gen = await loop.run_in_executor(
                 None, lambda: pctx.run(
                     router.assign, "__call__", (req,), {},
-                    multiplexed_model_id=model_id, streaming=True))
+                    multiplexed_model_id=model_id, streaming=True,
+                    stream_ring=ring_spec))
         except Exception as e:
+            if ring is not None:
+                ring.close(unlink=True)
             logger.error("serve proxy stream assign error: %r", e)
             return web.Response(status=500, text=repr(e))
         resp = web.StreamResponse(headers={
@@ -222,21 +318,34 @@ class Proxy:
         it = iter(gen)
         sentinel = object()
         try:
-            while True:
-                # next() blocks until the replica reports the next item;
-                # keep the proxy loop free while waiting.
+            carry = None  # a first item the ring handshake pass consumed
+            if ring is not None:
+                # The replica's first generator item is the ring handshake
+                # (ok/nak). Anything else means a producer that ignored
+                # the ring ask — fall back and emit that item normally.
                 ref = await loop.run_in_executor(
                     self._stream_pool, lambda: next(it, sentinel))
-                if ref is sentinel:
-                    break
-                item = await self._resolver.submit(ref)
-                if isinstance(item, bytes):
-                    data = item.decode("utf-8", "replace")
-                elif isinstance(item, str):
-                    data = item
+                first = (sentinel if ref is sentinel
+                         else await self._resolver.submit(ref))
+                if isinstance(first, dict) and "__rt_ring__" in first:
+                    if first["__rt_ring__"] == "ok":
+                        await self._stream_from_ring(resp, ring, gen, loop)
+                        return resp
+                    self._ring_nak[router.deployment] = loop.time()
+                elif first is not sentinel:
+                    carry = first
+            while True:
+                if carry is not None:
+                    item, carry = carry, None
                 else:
-                    data = json.dumps(item)
-                await resp.write(f"data: {data}\n\n".encode())
+                    # next() blocks until the replica reports the next
+                    # item; keep the proxy loop free while waiting.
+                    ref = await loop.run_in_executor(
+                        self._stream_pool, lambda: next(it, sentinel))
+                    if ref is sentinel:
+                        break
+                    item = await self._resolver.submit(ref)
+                await resp.write(self._sse_chunk(item))
             await resp.write(b"data: [DONE]\n\n")
             await resp.write_eof()
         except Exception as e:
@@ -257,6 +366,8 @@ class Proxy:
             # LLM stream keeps decoding to max_tokens for nobody.
             del it
             del gen
+            if ring is not None:
+                ring.close(unlink=True)
         return resp
 
     def _to_response(self, result):

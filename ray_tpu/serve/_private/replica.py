@@ -14,6 +14,8 @@ import asyncio
 import contextvars
 import inspect
 import json as _json
+import threading
+import time
 from typing import Any, Optional
 
 #: Model id of the request currently being handled (reference
@@ -106,14 +108,82 @@ class Replica:
             self.ema_latency_ms = (0.8 * self.ema_latency_ms + 0.2 * dt_ms
                                    if self.total > 1 else dt_ms)
 
+    def _pool(self):
+        """Dedicated stream executor (NOT the default executor): long
+        token streams park threads and must not starve handle_request's
+        sync offloads."""
+        if self._stream_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._stream_pool = ThreadPoolExecutor(
+                max_workers=64, thread_name_prefix="rt-repl-stream")
+        return self._stream_pool
+
+    # ------------------------------------------------- token-ring reply path
+    @staticmethod
+    def _ring_write(ring, rec, stop, park_s: float = 120.0) -> bool:
+        """One record into the stream ring with bounded-park backpressure:
+        a stalled/vanished consumer parks the producer (the ring is
+        BOUNDED — nothing buffers unboundedly) until the stream is
+        abandoned (stop) or the park cap trips. Returns False when the
+        record could not be delivered (consumer gone)."""
+        deadline = time.monotonic() + park_s
+        while not stop.is_set() and time.monotonic() < deadline:
+            try:
+                ring.write(rec, timeout=0.2)
+                return True
+            except TimeoutError:
+                continue  # ring full: consumer stalled; park bounded
+            except Exception:
+                return False  # ring closed/unlinked under us
+        return False
+
+    def _ring_pump(self, it, ring, stop) -> None:
+        """Executor-side pump: drain a sync iterator into the stream ring
+        (one record per item — items arrive pre-batched, e.g. one OpenAI
+        chunk per decode chunk via GenStream.next_batch). Owns the
+        iterator: on abandonment (stop) it closes it from THIS thread, so
+        generator finalizers (engine slot release) always actually run —
+        a cross-thread close() on an executing generator raises."""
+        finished = False
+        try:
+            while not stop.is_set():
+                try:
+                    item = next(it)
+                except StopIteration:
+                    self._ring_write(ring, ("end", None), stop)
+                    finished = True
+                    return
+                if not self._ring_write(ring, ("item", item), stop):
+                    return
+        except Exception as e:  # user iterator failure: attributed record
+            self._ring_write(ring, ("err", repr(e)), stop)
+            finished = True
+        finally:
+            if not finished:
+                close = getattr(it, "close", None)
+                if close is not None:
+                    try:
+                        close()
+                    except Exception:
+                        pass
+
     async def handle_request_streaming(self, method_name: str, args: tuple,
                                        kwargs: dict,
-                                       multiplexed_model_id: str = ""):
+                                       multiplexed_model_id: str = "",
+                                       stream_ring: Optional[dict] = None):
         """Streaming twin of handle_request: the user method returns an
         (async) generator/iterable whose items are yielded incrementally to
         the caller over the core streaming-generator transport (reference
         serve streaming responses / vLLM token streams). Called with
-        num_returns='streaming' by the router/proxy."""
+        num_returns='streaming' by the router/proxy.
+
+        With `stream_ring` (README "Serving hot loop") the items ride a
+        shm StreamRing straight to the proxy instead: ONE handshake item
+        confirms attachment over the generator, then every item is a ring
+        record — zero per-item ObjectRefs, per-item RPC, or per-item
+        owner bookkeeping on the reply path. Without the kwarg this
+        method is byte-identical to the classic path."""
         self.ongoing += 1
         self.total += 1
         _t0 = asyncio.get_event_loop().time()
@@ -124,26 +194,69 @@ class Replica:
             out = target(*args, **(kwargs or {}))
             if inspect.isawaitable(out):
                 out = await out
+            ring = None
+            if stream_ring is not None and (
+                    hasattr(out, "__anext__") or (
+                        hasattr(out, "__iter__")
+                        and not isinstance(out, (str, bytes, dict)))):
+                try:
+                    from ray_tpu.dag.stream import StreamRing
+
+                    ring = StreamRing.attach(stream_ring)
+                except Exception:
+                    ring = None  # cross-host / missing shm: classic path
+                # The handshake is the ONLY generator item in ring mode —
+                # the proxy reads it once, then drains the ring.
+                yield {"__rt_ring__": "ok" if ring is not None else "nak"}
+            if ring is not None:
+                loop = asyncio.get_event_loop()
+                stop = threading.Event()
+                try:
+                    if hasattr(out, "__anext__"):
+                        # Async source: items produced on the loop, each
+                        # ring write offloaded (it can park on
+                        # backpressure — never block the replica loop).
+                        try:
+                            async for item in out:
+                                ok = await loop.run_in_executor(
+                                    self._pool(), self._ring_write,
+                                    ring, ("item", item), stop)
+                                if not ok:
+                                    break
+                            else:
+                                await loop.run_in_executor(
+                                    self._pool(), self._ring_write,
+                                    ring, ("end", None), stop)
+                        except Exception as e:
+                            await loop.run_in_executor(
+                                self._pool(), self._ring_write,
+                                ring, ("err", repr(e)), stop)
+                    else:
+                        await loop.run_in_executor(
+                            self._pool(), self._ring_pump,
+                            iter(out), ring, stop)
+                finally:
+                    # Abandonment (gen_close -> aclose raises
+                    # GeneratorExit at the await): stop tells the pump to
+                    # exit and close its iterator from its own thread.
+                    stop.set()
+                    ring.close()
+                return
             if hasattr(out, "__anext__"):
                 async for item in out:
                     yield item
             elif hasattr(out, "__iter__") and not isinstance(
                     out, (str, bytes, dict)):
-                # Sync iterables' next() may block on an engine stream; a
-                # DEDICATED pool (not the default executor) so long token
-                # streams can't starve handle_request's sync offloads.
-                if self._stream_pool is None:
-                    from concurrent.futures import ThreadPoolExecutor
-
-                    self._stream_pool = ThreadPoolExecutor(
-                        max_workers=64, thread_name_prefix="rt-repl-stream")
+                # Sync iterables' next() may block on an engine stream:
+                # use the dedicated pool (see _pool).
+                pool = self._pool()
                 loop = asyncio.get_event_loop()
                 it = iter(out)
                 sentinel = object()
                 try:
                     while True:
                         item = await loop.run_in_executor(
-                            self._stream_pool, lambda: next(it, sentinel))
+                            pool, lambda: next(it, sentinel))
                         if item is sentinel:
                             break
                         yield item

@@ -194,14 +194,17 @@ class Router:
     # --------------------------------------------------------------- assign
     def assign(self, method_name: str, args: tuple, kwargs: dict,
                timeout: float = 30.0, multiplexed_model_id: str = "",
-               streaming: bool = False):
+               streaming: bool = False, stream_ring: Optional[dict] = None):
         """Pick a replica and dispatch; returns the result ObjectRef — or,
         with streaming=True, an ObjectRefGenerator of incremental results
         (the replica method runs as a streaming generator; reference
         serve's streaming response path over RequestRouter).
-        Multiplexed requests prefer replicas this router already routed the
-        model to (reference multiplex cache locality), then fall back to
-        pow-2-choices balancing."""
+        `stream_ring` (streaming only) asks the replica to deliver items
+        over a shm StreamRing instead of per-item streamed ObjectRefs
+        (README "Serving hot loop"); None keeps the classic reply path
+        byte-identical. Multiplexed requests prefer replicas this router
+        already routed the model to (reference multiplex cache locality),
+        then fall back to pow-2-choices balancing."""
         deadline = time.monotonic() + timeout
         last_demand_ping = 0.0
         while True:
@@ -273,10 +276,12 @@ class Router:
                     self._model_replicas.pop(
                         next(iter(self._model_replicas)))
         if streaming:
+            skw = {"multiplexed_model_id": multiplexed_model_id}
+            if stream_ring is not None:
+                skw["stream_ring"] = stream_ring
             gen = handle.handle_request_streaming.options(
                 num_returns="streaming").remote(
-                    method_name, args, kwargs,
-                    multiplexed_model_id=multiplexed_model_id)
+                    method_name, args, kwargs, **skw)
             with self._lock:
                 # The completion sentinel resolves when the stream ends —
                 # exactly when the request stops being "outstanding".

@@ -41,9 +41,12 @@ def test_engine_no_lockstep(engine):
     # 120 tokens (30 decode chunks): wide enough that the consumer thread
     # reliably observes the long request still active right after the short
     # one drains, even when a loaded CI box deschedules it for a while.
+    # Anchor the short submit on the long request's FIRST token rather
+    # than a wall-clock sleep: the pipelined hot loop decodes the whole
+    # 120 fast enough that a fixed sleep could eat its entire lifetime.
     long_s = engine.submit([5, 6, 7], SamplingParams(temperature=0.0,
                                                      max_tokens=120))
-    time.sleep(0.05)
+    first_long = long_s.next(timeout=60)
     t0 = time.monotonic()
     short = engine.submit([8, 9], SamplingParams(temperature=0.0,
                                                  max_tokens=3)).tokens()
@@ -51,7 +54,7 @@ def test_engine_no_lockstep(engine):
     # the long request must still be in flight when the short one finished
     assert engine.num_active >= 1
     assert len(short) == 3
-    long_toks = long_s.tokens()
+    long_toks = [first_long] + long_s.tokens()
     assert len(long_toks) == 120
     assert short_done < 30.0
 

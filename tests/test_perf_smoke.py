@@ -99,3 +99,19 @@ def test_bench_smoke_runs():
         f"armed telemetry costs {rep['details']['telemetry_overhead']}x "
         f"(off {m_off}/s vs on {m_on}/s medians) — budget is 1.05x "
         f"(noise-widened gate: {m_bound}x)")
+    # Serving hot loop (ISSUE 13 acceptance): end-to-end SSE streaming
+    # decode under 4 concurrent clients must hold >= 0.5x of the SAME
+    # engine's isolated rate (vs ~0.045x on the per-token reply path the
+    # token ring replaced). The bound is the spec'd 0.5 floor, noise-
+    # widened downward on boxes whose legs can't resolve it (README
+    # "Serving hot loop").
+    e2e = rep["details"].get("serve_decode_e2e_tok_s")
+    iso = rep["details"].get("serve_decode_engine_tok_s")
+    assert e2e and iso, (
+        "serve_decode_e2e lane missing (bench skipped it: see its stderr)")
+    s_ratio = rep["details"]["serve_decode_e2e_ratio"]
+    s_bound = rep["details"]["serve_decode_e2e_bound"]
+    assert s_ratio >= s_bound, (
+        f"end-to-end streaming decode is {s_ratio}x of the isolated "
+        f"engine ({e2e} vs {iso} tok/s medians) — the serving path is "
+        f"eating throughput again (gate bound {s_bound}x)")

@@ -96,6 +96,46 @@ def test_timeseries_kinds_and_monotone_timestamps(telemetry_cluster):
     assert cut  # sanity: the cutoff actually removed something
 
 
+def test_llm_tokens_per_s_series(telemetry_cluster):
+    """Engine-hosting workers export live decode throughput as the
+    dot-qualified `llm.tokens_per_s` series (README "Serving hot loop"):
+    the worker sampler reads the per-tick token rate and the controller
+    ingests the dotted key as-is instead of prefixing `worker.`."""
+    @ray_tpu.remote
+    class EngineHost:
+        def tick(self):
+            # The real engine counts via _deliver; the counter is the
+            # series' source either way (module presence gates sampling).
+            from ray_tpu.llm import engine as eng
+
+            eng._count_tokens(1000)
+            return True
+
+    h = EngineHost.remote()
+    deadline = time.monotonic() + 25
+    rows = []
+    while time.monotonic() < deadline:
+        ray_tpu.get(h.tick.remote(), timeout=30)
+        rows = state.timeseries(series="llm.tokens_per_s")
+        if rows and any(p[1] > 0 for r in rows for p in r["points"]):
+            break
+        time.sleep(0.2)
+    assert rows, "llm.tokens_per_s series never appeared"
+    assert any(p[1] > 0 for r in rows for p in r["points"]), rows
+    # Dot-qualified: never double-prefixed into worker.llm.tokens_per_s.
+    assert not state.timeseries(series="worker.llm.tokens_per_s")
+    # cluster_utilization keeps the qualified key — `ray-tpu top`'s TOK/S
+    # column reads workers[wid]["llm.tokens_per_s"] verbatim.
+    util = state.cluster_utilization()
+    worker_series = [w for n in util["nodes"].values()
+                     for w in (n.get("workers") or {}).values()]
+    assert any("llm.tokens_per_s" in w for w in worker_series), util
+    from ray_tpu.scripts.cli import _top_lines
+
+    frame = "\n".join(_top_lines(util))
+    assert "TOK/S" in frame
+
+
 def test_cluster_utilization_shape(telemetry_cluster):
     @ray_tpu.remote
     def one():
