@@ -245,6 +245,11 @@ def main(smoke: bool = False):
         # RT_TELEMETRY_INTERVAL_S=1 — off is byte-identical (no sampler
         # thread), on must stay under 5% on the task-throughput lane.
         _bench_telemetry_overhead(extra_details)
+        # Event plane A/B (perf-gate input): lifecycle-event emission is
+        # always-on by default — the driver task hot path must sit within
+        # the noise bound of RT_EVENTS_BUFFER=0 (events are emitted at
+        # lifecycle rate, never per task).
+        _bench_events_overhead(extra_details)
         # Serving hot loop (perf-gate input, ISSUE 13): end-to-end SSE
         # streaming decode through proxy+replica+token-ring vs the SAME
         # engine isolated in-process — the ratio is the serving tax. The
@@ -553,6 +558,48 @@ def _bench_telemetry_overhead(details: dict):
                 pass
 
     _ab_overhead_lane("telemetry", run_once, details)
+
+
+def _bench_events_overhead(details: dict):
+    """Event-plane A/B (smoke only; README "Cluster events"): the
+    single_client_tasks_async workload with the plane at its default
+    (always-on, RT_EVENTS_BUFFER=2048) vs disabled (RT_EVENTS_BUFFER=0).
+    The perf gate (tests/test_perf_smoke.py, RT_RUN_PERF=1) asserts the
+    default-on path stays within the noise bound of plane-off: lifecycle
+    events are emitted at transition rate — NOTHING on the per-task hot
+    path emits, so the measured overhead is the cost of a handful of
+    bounded-ring appends per cluster lifetime."""
+    import ray_tpu
+
+    def run_once(events_on: bool) -> float:
+        prev = os.environ.pop("RT_EVENTS_BUFFER", None)
+        if not events_on:
+            os.environ["RT_EVENTS_BUFFER"] = "0"
+        try:
+            ray_tpu.init(num_cpus=4)
+
+            @ray_tpu.remote
+            def noop():
+                return None
+
+            ray_tpu.get([noop.remote() for _ in range(8)], timeout=120)
+            return timeit(
+                f"single client tasks async "
+                f"(events {'on' if events_on else 'off'})",
+                lambda: ray_tpu.get([noop.remote() for _ in range(100)],
+                                    timeout=120),
+                multiplier=100, min_time=max(MIN_TIME, 1.0))
+        finally:
+            if prev is None:
+                os.environ.pop("RT_EVENTS_BUFFER", None)
+            else:
+                os.environ["RT_EVENTS_BUFFER"] = prev
+            try:
+                ray_tpu.shutdown()
+            except Exception:
+                pass
+
+    _ab_overhead_lane("events", run_once, details)
 
 
 # ---- compiled-graph channel round-trip (native futex ring) ---------------

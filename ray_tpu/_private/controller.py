@@ -205,6 +205,20 @@ class Controller:
         # sweep drains a bounded batch per tick and sheds the rest (ring
         # discipline, same as the flight recorder).
         self._evicted_traces: deque = deque(maxlen=256)
+        # Cluster event plane (README "Cluster events"): lifecycle events
+        # in a bounded arrival-order ring (seq = arrival order, minted
+        # here), plus a per-entity secondary index so "what happened to
+        # actor X" is O(that entity's events). Settled events persist as
+        # segmented JSONL through the storage plane (_event_sweep).
+        self.events: deque = deque()
+        self._event_seq = 0  # next seq to mint; snapshot/restore-durable
+        self._event_index: dict[str, deque] = {}
+        self._event_sweep_task: Optional[asyncio.Task] = None
+        # Events awaiting segment persistence (bounded; a long backend
+        # outage sheds OLDEST and counts them into _events_dropped).
+        self._evseg_buf: list = []
+        self._evseg_tail_written = -1  # last seq the current.jsonl tail has
+        self._events_dropped = 0
         # task_id -> (force, expiry), for cancels that land while the task is
         # queued or mid-dispatch (neither pending nor dispatched yet).
         # Entries expire so cancels racing completion (or actor-method refs
@@ -215,6 +229,15 @@ class Controller:
         import threading as _threading
 
         self._persist_io_lock = _threading.Lock()
+        # Serializes event-segment writes: the sweep's executor job vs
+        # stop()'s synchronous final flush (same shape as the snapshot
+        # path's _persist_io_lock — unordered cross-thread current.jsonl
+        # writes could lose the newest tail to a stale one). The watermark
+        # ORDERS them: a writer whose coverage is below what already
+        # landed skips the current.jsonl rewrite (locks alone only
+        # serialize; a stale writer acquiring second would still win).
+        self._event_io_lock = _threading.Lock()
+        self._evseg_current_hi = -1  # newest seq current.jsonl covers
         # task_id -> (task_done payload, expiry): completions whose task_done
         # beat the dispatch *reply* (worker reports straight to the
         # controller; the agent's reply rides another connection). Replayed
@@ -305,6 +328,10 @@ class Controller:
             if any(e.state == "RECOVERING" for e in self.actors.values()):
                 self._tasks.append(
                     asyncio.ensure_future(self._reconcile_recovering()))
+        # Event-plane seq fencing: a restored (or re-started-into-session)
+        # head must mint seqs ABOVE anything already persisted, or fresh
+        # events would collide with segment history (pinned by test).
+        self._restore_event_seq()
         self.port = await self.server.start(host, port)
         self._tasks.append(asyncio.ensure_future(self._schedule_loop()))
         self._tasks.append(asyncio.ensure_future(self._health_loop()))
@@ -335,10 +362,15 @@ class Controller:
                 from ray_tpu._private.serialization import dumps_oob
 
                 ent.state = "DEAD"
+                self._emit_event(
+                    "actor_death",
+                    f"actor {aid[:12]} did not survive the controller "
+                    f"restart (worker and owner gone)", entity=(aid,))
                 h, bufs = dumps_oob({
                     "type": "ActorDiedError",
                     "message": f"actor {aid[:12]} did not survive the "
-                               f"controller restart (worker and owner gone)"})
+                               f"controller restart (worker and owner gone)"
+                               + self._event_hint(aid)})
                 ent.death_cause = [h, *bufs]
                 if ent.name:
                     # Free the name like every other death path does
@@ -419,6 +451,8 @@ class Controller:
             return
         self.kv = snap.get("kv", {})
         self.named_actors = snap.get("named_actors", {})
+        self._event_seq = max(self._event_seq,
+                              int(snap.get("events_seq") or 0))
         if snap.get("session_id"):
             # Adopt the previous incarnation's session: agents/workers that
             # survived the restart registered their shm segments under it.
@@ -478,6 +512,10 @@ class Controller:
             "pgs": {pid: {"bundles_raw": pg["bundles_raw"],
                           "strategy": pg["strategy"], "name": pg.get("name")}
                     for pid, pg in self.pgs.items()},
+            # Event-plane seq watermark: restore resumes minting above it
+            # (belt; _restore_event_seq's segment scan is the braces for
+            # seqs minted after the last snapshot).
+            "events_seq": self._event_seq,
         }
 
     def _dump_snapshot(self, snap: dict):
@@ -503,6 +541,20 @@ class Controller:
                 self._write_snapshot()  # acknowledged writes survive shutdown
             except Exception:
                 logger.exception("controller: final persist failed")
+        # Final event flush: history already ingested must not lose its
+        # last sweep-tick's worth to the shutdown (durable = durable).
+        try:
+            d = self._event_dir()
+            if d is not None and self._evseg_buf:
+                tail_hi = self._evseg_buf[-1]["seq"]
+                if tail_hi > self._evseg_tail_written:
+                    self._persist_event_segments_sync(
+                        d, [], list(self._evseg_buf),
+                        max(1, int(CONFIG.events_keep_segments)), 0)
+                    self._evseg_tail_written = tail_hi
+        except Exception:
+            logger.debug("controller: final event flush failed",
+                         exc_info=True)
         for nid, conn in list(self.node_conns.items()):
             try:
                 await conn.push("shutdown")
@@ -642,6 +694,12 @@ class Controller:
             self._publish("actor", {"actor_id": aid, "state": "ALIVE"})
             logger.info("actor %s re-bound to surviving worker %s",
                         aid[:8], w["worker_id"][:8])
+            self._emit_event(
+                "actor_ready",
+                f"actor {aid[:12]} re-bound to surviving worker "
+                f"{w['worker_id'][:12]}",
+                entity=(aid, w["worker_id"]), node_id=nid,
+                attrs={"rebound": True})
         elif w.get("state") == "busy" and held:
             # A controller-dispatched task still running; charge its
             # resources so the scheduler doesn't oversubscribe the node,
@@ -705,6 +763,13 @@ class Controller:
                 "rejected stale-incarnation lease %s for node %s "
                 "(incarnation %s, current %s)", lid[:8], nid[:8], inc,
                 node.incarnation)
+            self._emit_event(
+                "incarnation_fenced",
+                f"rejected lease {lid[:8]} reasserted against node "
+                f"{nid[:8]}'s previous life (incarnation {inc}, current "
+                f"{node.incarnation})",
+                entity=(lid, nid, owner), node_id=nid,
+                attrs={"stale": inc, "current": node.incarnation})
             oconn = self.client_conns.get(owner)
             if oconn is not None and not oconn.closed:
                 try:
@@ -786,6 +851,12 @@ class Controller:
                 logger.info("node %s re-registered (was %s) as incarnation "
                             "%d; reconciled in place", nid[:8], was,
                             incarnation)
+                self._emit_event(
+                    "node_reconciled",
+                    f"node {nid[:8]} re-registered (was {was}) and "
+                    f"reconciled in place",
+                    entity=(nid,), node_id=nid,
+                    attrs={"incarnation": incarnation, "was": was})
             else:
                 node = NodeState(nid, tuple(a["address"]),
                                  ResourceSet(_raw=a["resources"]), a.get("labels"))
@@ -806,6 +877,11 @@ class Controller:
                     await self._reconcile_reported_worker(nid, node, w)
                 logger.info("node %s registered with %s (incarnation %d)",
                             nid[:8], node.total.to_dict(), incarnation)
+                self._emit_event(
+                    "node_register",
+                    f"node {nid[:8]} registered with {node.total.to_dict()}",
+                    entity=(nid,), node_id=nid,
+                    attrs={"incarnation": incarnation})
             if self._parked_reasserts:
                 self._retry_parked_reasserts()
             self._retry_pending_pgs()
@@ -845,6 +921,12 @@ class Controller:
                 "rejected stale-incarnation message for node %s "
                 "(incarnation %s, current %s)", nid[:8], inc,
                 node.incarnation)
+            self._emit_event(
+                "incarnation_fenced",
+                f"rejected a message from node {nid[:8]}'s previous life "
+                f"(incarnation {inc}, current {node.incarnation})",
+                entity=(nid,), node_id=nid,
+                attrs={"stale": inc, "current": node.incarnation})
             return None
         return node
 
@@ -862,6 +944,9 @@ class Controller:
             telem = a.get("telemetry")
             if telem:
                 self._ingest_telemetry(a["node_id"], telem)
+            evs = a.get("events")
+            if evs:
+                self._ingest_events(evs, default_node=a["node_id"])
 
     # ---------------------------------------------------------- scheduling
     def _kick(self):
@@ -1624,14 +1709,26 @@ class Controller:
                     pass
 
     async def _lease_worker_died(self, worker_id: str, cause: str | None = None):
+        from ray_tpu._private import events as _events
+
         for lease_id, ent in list(self.leases.items()):
             if ent["worker_id"] == worker_id:
                 self._drop_lease(lease_id)
+                # One normalized cause vocabulary end to end: the lease
+                # holder's failure messages key off it ("oom"/"stall"),
+                # and `ray-tpu events` queries by cause actually match.
+                norm = _events.normalize_exit_cause(cause)
+                self._emit_event(
+                    "lease_failover",
+                    f"lease {lease_id[:8]} invalidated: worker "
+                    f"{worker_id[:12]} died ({norm}); in-flight specs fail "
+                    f"over", entity=(lease_id, worker_id, ent["owner"]),
+                    node_id=ent.get("node_id"), attrs={"cause": norm})
                 oconn = self.client_conns.get(ent["owner"])
                 if oconn is not None and not oconn.closed:
                     try:
                         await oconn.push("lease_invalid", lease_id=lease_id,
-                                         cause=cause or "worker died")
+                                         cause=norm)
                     except Exception:
                         pass
         # A pooled (returned-but-warm) worker dying must leave the pool, or
@@ -1778,6 +1875,10 @@ class Controller:
             job["status"] = "FAILED"
             job["message"] = rep.get("message", "spawn failed")
             job["end_time"] = time.time()
+        self._emit_event(
+            "job_start",
+            f"job {sid} ({a['entrypoint']!r}) -> {job['status']}",
+            entity=(sid,), node_id=nid, attrs={"status": job["status"]})
         return {"submission_id": sid, "status": job["status"]}
 
     async def _p_job_done(self, conn, a):
@@ -1796,6 +1897,13 @@ class Controller:
             job["status"] = "FAILED"
             job["message"] = f"entrypoint exited with code {rc}"
         job["end_time"] = time.time()
+        self._emit_event(
+            "job_stop",
+            f"job {job['submission_id']} -> {job['status']}"
+            + (f" ({job['message']})" if job.get("message") else ""),
+            severity=("warning" if job["status"] == "FAILED" else "info"),
+            entity=(job["submission_id"],), node_id=a.get("node_id"),
+            attrs={"status": job["status"], "returncode": rc})
         self._publish("job", {"submission_id": job["submission_id"],
                               "status": job["status"]})
 
@@ -1831,7 +1939,8 @@ class Controller:
             raise rpc.RpcError(f"job {sid} not found")
         nconn = self.node_conns.get(job["node_id"])
         if nconn is None or nconn.closed:
-            return {"data": b"", "offset": int(a.get("offset", 0)), "found": False}
+            return {"data": b"", "offset": int(a.get("offset", 0)),
+                    "found": False, "truncated": False}
         return await nconn.call("job_logs", submission_id=sid,
                                 offset=int(a.get("offset", 0)))
 
@@ -1890,6 +1999,9 @@ class Controller:
         spans = a.get("spans")
         if spans:
             self._ingest_spans(spans)
+        evs = a.get("events")
+        if evs:
+            self._ingest_events(evs)
 
     async def _h_get_metrics(self, conn, a):
         # Aggregated application series PLUS the controller's
@@ -1935,6 +2047,7 @@ class Controller:
             "clients": len(self.client_conns),
             "kv": len(self.kv),
             "traces": len(self.traces),
+            "events": len(self.events),
         }
 
     def _telem_append(self, key: tuple, ts: float, val) -> None:
@@ -2422,6 +2535,349 @@ class Controller:
     async def _p_task_events(self, conn, a):
         self.task_events.extend(a["events"])
 
+    # ------------------------------------------------------ event plane
+    # README "Cluster events": the controller is the aggregation point for
+    # lifecycle events — its own emissions (node/actor/lease/job
+    # transitions), agent batches riding heartbeats/worker_died pushes, and
+    # worker/driver batches riding metrics-flush frames.
+    _EVENT_INDEX_PER_ENTITY = 128   # events kept per entity in the index
+    _EVENT_INDEX_ENTITIES = 2048    # entities indexed (oldest-first evict)
+
+    def _emit_event(self, kind: str, message: str = "", *,
+                    severity: str | None = None, entity=(),
+                    node_id: str | None = None,
+                    trace_id: str | None = None,
+                    attrs: dict | None = None) -> None:
+        """Controller-side emission: mint + ingest directly (no ring hop)."""
+        if int(CONFIG.events_buffer) <= 0:
+            return
+        from ray_tpu._private import events as _events
+
+        self._ingest_events([_events.build_event(
+            kind, message, severity=severity, entity=entity,
+            node_id=node_id, trace_id=trace_id, attrs=attrs,
+            src="controller")])
+
+    def _ingest_events(self, evs: list, default_node: str | None = None) -> None:
+        """Assign monotonic seqs in arrival order and index into the ring,
+        the per-entity index, and the persistence buffer."""
+        cap = int(CONFIG.events_buffer)
+        if cap <= 0 or not evs:
+            return
+        persist = bool(CONFIG.events_persist)
+        for ev in evs:
+            if not isinstance(ev, dict) or not ev.get("kind"):
+                continue
+            ev["seq"] = self._event_seq
+            self._event_seq += 1
+            if ev.get("node") is None and default_node is not None:
+                ev["node"] = default_node
+            self.events.append(ev)
+            while len(self.events) > cap:
+                self.events.popleft()
+            for eid in ev.get("entity") or ():
+                # Pop + reinsert so dict order is last-TOUCHED: eviction
+                # takes the coldest entity, not a hot long-lived one (the
+                # head node's id gets events for the cluster's lifetime).
+                dq = self._event_index.pop(eid, None)
+                if dq is None:
+                    while len(self._event_index) >= self._EVENT_INDEX_ENTITIES:
+                        self._event_index.pop(
+                            next(iter(self._event_index)), None)
+                    dq = deque(maxlen=self._EVENT_INDEX_PER_ENTITY)
+                self._event_index[eid] = dq
+                dq.append(ev)
+            if persist:
+                self._evseg_buf.append(ev)
+        if persist:
+            # Bound the persistence backlog (backend severed/slow): shed
+            # OLDEST — ring discipline, counted so the next successful
+            # segment carries an events_dropped marker.
+            lim = max(4 * int(CONFIG.events_segment_events), cap)
+            over = len(self._evseg_buf) - lim
+            if over > 0:
+                del self._evseg_buf[:over]
+                self._events_dropped += over
+            if self._event_sweep_task is None and not self._stopping:
+                try:
+                    self._event_sweep_task = asyncio.ensure_future(
+                        self._event_sweep())
+                    self._tasks.append(self._event_sweep_task)
+                except RuntimeError:
+                    pass  # no running loop (unit tests drive persistence
+                    #       synchronously via the sync helpers)
+
+    def _event_hint(self, entity: str | None) -> str:
+        """Error-message enrichment: the seq range of the events explaining
+        an entity's fate, so an ActorDiedError/ObjectLostError names where
+        to look ("" when the plane is off or the entity has no events)."""
+        if not entity:
+            return ""
+        dq = self._event_index.get(entity)
+        if not dq:
+            return ""
+        try:
+            lo, hi = dq[0]["seq"], dq[-1]["seq"]
+        except (IndexError, KeyError):
+            return ""
+        rng = str(lo) if lo == hi else f"{lo}-{hi}"
+        return (f" [events {rng}: ray-tpu events --entity "
+                f"{str(entity)[:12]}]")
+
+    def _event_dir(self) -> str | None:
+        if not CONFIG.events_persist or int(CONFIG.events_buffer) <= 0:
+            return None
+        d = CONFIG.events_dir
+        if d:
+            return d
+        from ray_tpu._private import events as _events
+
+        return _events.default_events_dir(self.session_id)
+
+    _EVENT_SEG_RE = None  # compiled lazily (module re import stays top-free)
+
+    @classmethod
+    def _event_seg_seq(cls, name: str):
+        """seg-<last_seq>.jsonl -> last_seq, else None."""
+        import re
+
+        if cls._EVENT_SEG_RE is None:
+            cls._EVENT_SEG_RE = re.compile(r"^seg-(\d+)\.jsonl$")
+        m = cls._EVENT_SEG_RE.match(name)
+        return int(m.group(1)) if m else None
+
+    def _restore_event_seq(self) -> None:
+        """Boot-time restore of the event plane from persisted segments:
+        (a) the seq fence — never mint a seq <= anything already persisted
+        (segments outlive snapshots; the snapshot's watermark can lag the
+        last sweep) — and (b) the queryable history: the newest
+        ring-capacity worth of persisted events reload into the arrival
+        ring + entity index, so `ray-tpu events` still answers "what
+        happened" across a controller restart. current.jsonl's tail also
+        refills the persistence buffer (those events live in NO full
+        segment yet; the next tail rewrite must not drop them from
+        durable storage)."""
+        d = self._event_dir()
+        if d is None:
+            return
+        try:
+            import json as _json
+
+            from ray_tpu import storage
+
+            hi = self._event_seq - 1
+            # listdir returns [] for a genuinely absent dir; an EXCEPTION
+            # is a backend problem. Retry transient blips (the PR 8
+            # _restore_state discipline): silently treating one as "no
+            # history" would skip the seq fence and let this head re-mint
+            # seqs that collide with (and later overwrite) persisted
+            # segments.
+            import time as _time
+
+            names = None
+            delay = 0.1
+            for attempt in range(4):
+                try:
+                    names = storage.listdir(d)
+                    break
+                except storage.StorageTransientError:
+                    if attempt == 3:
+                        raise
+                    _time.sleep(delay)
+                    delay *= 2
+            cap = max(1, int(CONFIG.events_buffer))
+            segs = sorted((n for n in names
+                           if self._event_seg_seq(n) is not None),
+                          key=self._event_seg_seq)
+            # Highest seq any FULL segment covers — strictly from segment
+            # names, NOT the snapshot watermark: a watermark ahead of
+            # persistence must not trick the tail refill below into
+            # thinking current.jsonl's events are segment-covered (the
+            # next tail rewrite would drop them from durable storage).
+            seg_hi = -1
+            for n in segs:
+                seg_hi = max(seg_hi, self._event_seg_seq(n))
+            hi = max(hi, seg_hi)
+            by_seq: dict[int, dict] = {}
+            # Newest segments first, until the ring capacity is covered.
+            for n in reversed(segs):
+                if len(by_seq) >= cap:
+                    break
+                try:
+                    for ln in storage.get_bytes(
+                            storage.join(d, n)).splitlines():
+                        if ln.strip():
+                            ev = _json.loads(ln)
+                            if isinstance(ev.get("seq"), int):
+                                by_seq[ev["seq"]] = ev
+                except Exception:
+                    pass
+            tail: list = []
+            if "current.jsonl" in names:
+                try:
+                    for ln in storage.get_bytes(
+                            storage.join(d, "current.jsonl")).splitlines():
+                        if ln.strip():
+                            ev = _json.loads(ln)
+                            if isinstance(ev.get("seq"), int):
+                                tail.append(ev)
+                except Exception:
+                    pass
+            # Dedup by seq: a crash between a seg-N write and the
+            # current.jsonl rewrite leaves the tail in BOTH files — the
+            # seq is the identity, so the duplicate collapses here (and
+            # only tail events no segment covers refill the buffer below,
+            # so it never becomes permanent in durable history).
+            for ev in tail:
+                hi = max(hi, ev["seq"])
+                by_seq.setdefault(ev["seq"], ev)
+            restored = [by_seq[s] for s in sorted(by_seq)][-cap:]
+            for ev in restored:
+                self.events.append(ev)
+                for eid in ev.get("entity") or ():
+                    dq = self._event_index.get(eid)
+                    if dq is None:
+                        dq = self._event_index[eid] = deque(
+                            maxlen=self._EVENT_INDEX_PER_ENTITY)
+                    dq.append(ev)
+            # Tail events durable ONLY in current.jsonl (seq above every
+            # full segment's) go back in the persistence buffer so they
+            # roll into a real segment eventually.
+            buf_tail = sorted((e for e in tail if e["seq"] > seg_hi),
+                              key=lambda e: e["seq"])
+            self._evseg_buf.extend(buf_tail)
+            if buf_tail:
+                self._evseg_tail_written = buf_tail[-1]["seq"]
+            self._event_seq = max(self._event_seq, hi + 1)
+        except Exception:
+            logger.exception("event-plane restore failed; minting from "
+                             "the snapshot watermark")
+
+    async def _event_sweep(self):
+        """Persist settled events as segmented JSONL through the storage
+        plane, batched and OFF the event loop (the trace-sweep idiom). A
+        failed tick (severed sim:// backend, storage blip) keeps the
+        buffer and retries — persistence picks up when the backend heals
+        (chaos-pinned)."""
+        while not self._stopping:
+            await asyncio.sleep(1.0)
+            try:
+                d = self._event_dir()
+                if d is None:
+                    self._evseg_buf.clear()
+                    continue
+                seg_n = max(16, int(CONFIG.events_segment_events))
+                n_full = len(self._evseg_buf) // seg_n
+                full = [list(self._evseg_buf[i * seg_n:(i + 1) * seg_n])
+                        for i in range(n_full)]
+                tail = list(self._evseg_buf[n_full * seg_n:])
+                tail_hi = tail[-1]["seq"] if tail else -1
+                if not full and tail_hi <= self._evseg_tail_written:
+                    continue  # nothing new since the last write
+                dropped, self._events_dropped = self._events_dropped, 0
+                keep = max(1, int(CONFIG.events_keep_segments))
+                loop = asyncio.get_running_loop()
+                try:
+                    await loop.run_in_executor(
+                        None, self._persist_event_segments_sync, d, full,
+                        tail, keep, dropped)
+                except Exception:
+                    self._events_dropped += dropped
+                    raise
+                # Success: full segments leave the buffer — BY SEQ, not by
+                # count: the overflow shed in _ingest_events may have run
+                # during the awaited write and already removed some of the
+                # front, so a count-based del would take newer, never-
+                # written events with it. The tail stays (it re-rolls into
+                # the next full segment) but its write watermark advances
+                # so quiet ticks skip the rewrite.
+                if full:
+                    written_hi = full[-1][-1]["seq"]
+                    buf = self._evseg_buf
+                    while buf and buf[0]["seq"] <= written_hi:
+                        buf.pop(0)
+                self._evseg_tail_written = tail_hi
+                if dropped:
+                    self._emit_event(
+                        "events_dropped",
+                        f"{dropped} event(s) shed while the events backend "
+                        f"was unreachable", attrs={"count": dropped})
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                logger.exception("event persistence sweep tick failed; "
+                                 "retrying")
+
+    def _persist_event_segments_sync(self, events_dir: str, full: list,
+                                     tail: list, keep: int,
+                                     dropped: int) -> None:
+        import json
+
+        from ray_tpu import storage
+
+        def _dump(evs):
+            return ("\n".join(json.dumps(e, default=str)
+                              for e in evs) + "\n").encode()
+
+        with self._event_io_lock:
+            for seg in full:
+                storage.put(
+                    storage.join(events_dir,
+                                 f"seg-{seg[-1]['seq']:016d}.jsonl"),
+                    _dump(seg))
+            # The in-progress tail rewrites atomically each sweep so a
+            # crash loses at most one tick of history. Watermark-gated: a
+            # STALE writer (an executor sweep job that lost the race to
+            # stop()'s final flush) must not overwrite a newer tail —
+            # its coverage ends below what already landed.
+            cover_hi = max(
+                full[-1][-1]["seq"] if full else -1,
+                tail[-1]["seq"] if tail else -1)
+            if cover_hi >= self._evseg_current_hi:
+                storage.put(storage.join(events_dir, "current.jsonl"),
+                            _dump(tail) if tail else b"")
+                self._evseg_current_hi = cover_hi
+            if full:
+                segs = sorted(
+                    (n for n in storage.listdir(events_dir)
+                     if self._event_seg_seq(n) is not None),
+                    key=self._event_seg_seq)
+                for victim in segs[:-keep] if len(segs) > keep else ():
+                    try:
+                        storage.delete(storage.join(events_dir, victim))
+                    except Exception:
+                        pass
+
+    async def _h_list_events(self, conn, a):
+        """Query the event ring: entity= (prefix-matches ANY of an event's
+        entity ids, served from the secondary index), kind=, severity=,
+        since= (seq, exclusive). Uniform truncation contract; `next_seq`
+        feeds `ray-tpu events --follow` polling."""
+        entity = a.get("entity") or None
+        kind = a.get("kind") or None
+        severity = a.get("severity") or None
+        since = a.get("since")
+        since = int(since) if since is not None else None
+        limit = int(a.get("limit", 1000))
+        if entity is not None:
+            seen: dict[int, dict] = {}
+            for eid, dq in self._event_index.items():
+                if eid.startswith(entity):
+                    for ev in dq:
+                        seen[ev["seq"]] = ev
+            rows = [seen[s] for s in sorted(seen)]
+        else:
+            rows = list(self.events)
+        if kind is not None:
+            rows = [e for e in rows if e.get("kind") == kind]
+        if severity is not None:
+            rows = [e for e in rows if e.get("sev") == severity]
+        if since is not None:
+            rows = [e for e in rows if e.get("seq", 0) > since]
+        return {"events": rows[-limit:], "truncated": len(rows) > limit,
+                "next_seq": self._event_seq,
+                "dropped": self._events_dropped}
+
     # ------------------------------------------------------ stall detection
     async def _p_stall_report(self, conn, a):
         """One escalation-ladder stage observed somewhere in the cluster
@@ -2444,6 +2900,18 @@ class Controller:
         if isinstance(stacks, str) and len(stacks) > 4000:
             report["stacks"] = stacks[-4000:]
         self.stalls.append(report)
+        stage = str(report.get("stage") or "?")
+        self._emit_event(
+            "stall",
+            f"stall {stage}: {report.get('name') or report.get('scope')} "
+            f"silent {report.get('silence_s')}s — "
+            f"{(report.get('reason') or '')[:120]}",
+            severity=("error" if stage == "kill" else "warning"),
+            entity=(report.get("task_id"), report.get("worker_id")),
+            node_id=report.get("node_id"),
+            trace_id=report.get("trace_id"),
+            attrs={"stage": stage, "scope": report.get("scope"),
+                   "silence_s": report.get("silence_s")})
         await self._p_metrics_report(None, {"records": [{
             "kind": "counter", "name": "rt_stalls_total",
             "desc": "stall escalations (warn/dump/kill stages observed)",
@@ -2787,6 +3255,10 @@ class Controller:
         self.actors[spec.actor_id] = _ActorEntry(spec)
         self._mark_dirty()
         self.pending.append(spec)
+        self._emit_event("actor_create",
+                         f"actor {spec.name} ({spec.actor_id[:12]}) queued",
+                         entity=(spec.actor_id,),
+                         attrs={"name": spec.name})
         self._kick()
         return {"actor_id": spec.actor_id, "existing": False}
 
@@ -2812,6 +3284,11 @@ class Controller:
             ent.death_cause = a["error"]
             self._release_actor_resources(ent)
             self._mark_dirty()
+            self._emit_event(
+                "actor_death",
+                f"actor {spec.name} ({spec.actor_id[:12]}) died: __init__ "
+                f"raised", entity=(spec.actor_id, ent.worker_id),
+                node_id=ent.node_id)
             ent.wake()
             return
         ent.state = "ALIVE"
@@ -2820,6 +3297,12 @@ class Controller:
         if ent.worker_id:
             self._actor_host_workers.add(ent.worker_id)
         ent.instance += 1
+        self._emit_event(
+            "actor_ready",
+            f"actor {spec.name} ({spec.actor_id[:12]}) alive "
+            f"(instance {ent.instance})",
+            entity=(spec.actor_id, ent.worker_id), node_id=ent.node_id,
+            attrs={"instance": ent.instance})
         ent.wake()
         logger.info("actor %s alive at %s", spec.name, ent.address)
 
@@ -2900,7 +3383,12 @@ class Controller:
 
         ent.state = "DEAD"
         self._publish_actor_state(ent)
-        h, b = dumps_oob({"type": "ActorDiedError", "message": reason})
+        aid = ent.spec.actor_id
+        self._emit_event("actor_death",
+                         f"actor {ent.spec.name} ({aid[:12]}) died: {reason}",
+                         entity=(aid,), attrs={"reason": reason})
+        h, b = dumps_oob({"type": "ActorDiedError",
+                          "message": reason + self._event_hint(aid)})
         ent.death_cause = [h, *b]
         self._release_actor_resources(ent)
         self._mark_dirty()
@@ -2934,6 +3422,13 @@ class Controller:
             self._publish_actor_state(ent)
             ent.address = None
             logger.info("restarting actor %s (%d used): %s", ent.spec.name, ent.restarts_used, reason)
+            self._emit_event(
+                "actor_restart",
+                f"actor {ent.spec.name} ({actor_id[:12]}) restarting "
+                f"({ent.restarts_used} used): {reason}",
+                entity=(actor_id,),
+                attrs={"restarts_used": ent.restarts_used,
+                       "reason": reason})
             respawn = ent.spec
             respawn.attempt += 1
             self.pending.append(respawn)
@@ -2943,7 +3438,14 @@ class Controller:
             self._publish_actor_state(ent)
             from ray_tpu._private.serialization import dumps_oob
 
-            h, b = dumps_oob({"type": "ActorDiedError", "message": reason})
+            self._emit_event(
+                "actor_death",
+                f"actor {ent.spec.name} ({actor_id[:12]}) died: {reason}",
+                entity=(actor_id,), attrs={"reason": reason})
+            # Error enrichment (README "Cluster events"): the error a
+            # caller sees names the event seqs that explain the death.
+            h, b = dumps_oob({"type": "ActorDiedError",
+                              "message": reason + self._event_hint(actor_id)})
             ent.death_cause = [h, *b]
             self._release_actor_resources(ent)
             self._mark_dirty()
@@ -2983,6 +3485,12 @@ class Controller:
         oids = self._device_index.pop(worker_id, None)
         if not oids:
             return
+        self._emit_event(
+            "device_objects_lost",
+            f"{len(oids)} device object(s) lost: producing worker "
+            f"{worker_id[:12]} {why}",
+            entity=(worker_id,), attrs={"count": len(oids)})
+        hint = self._event_hint(worker_id)
         for oid in oids:
             ent = self.objects.get(oid)
             if ent is None or ent.plane != "device" or ent.state != "ready":
@@ -2990,7 +3498,7 @@ class Controller:
             await self._mark_device_lost(
                 oid, ent,
                 f"device object {oid[:16]} lost: producing worker "
-                f"{worker_id[:12]} {why}")
+                f"{worker_id[:12]} {why}" + hint)
 
     async def _actor_worker_died(self, actor_id: str, reason: str,
                                  worker_id: str | None = None,
@@ -3029,6 +3537,12 @@ class Controller:
         if conn is not None and conn.meta.get("kind") == "node" \
                 and self._fenced_node(conn, a) is None:
             return  # stale-incarnation zombie: must not kill current state
+        # The agent's pending events (incl. this death's worker_exit) ride
+        # the report itself, so their seqs land BEFORE the restart/failover
+        # events this handler mints — causal chains stay ordered.
+        evs = a.get("events")
+        if evs:
+            self._ingest_events(evs, default_node=a.get("node_id"))
         cause = a.get("cause")
         if a.get("worker_id"):
             await self._device_objects_lost(a["worker_id"], "process died")
@@ -3081,6 +3595,11 @@ class Controller:
             self.node_conns.pop(nid, None)
         logger.warning("node %s connection lost; SUSPECT for %.1fs grace "
                        "(incarnation %d)", nid[:8], grace, incarnation)
+        self._emit_event(
+            "node_suspect",
+            f"node {nid[:8]} connection lost; SUSPECT for {grace:.1f}s",
+            entity=(nid,), node_id=nid,
+            attrs={"incarnation": incarnation, "grace_s": grace})
         self._publish("node", {"node_id": nid, "alive": False,
                                "liveness": "SUSPECT"})
         await asyncio.sleep(grace)
@@ -3196,15 +3715,29 @@ class Controller:
             t: (n, r) for t, (n, r) in self._reconciled_busy.items()
             if n != nid}
         logger.warning("node %s died", nid[:8])
+        self._emit_event("node_dead", f"node {nid[:8]} declared dead",
+                         entity=(nid,), node_id=nid)
         self._publish("node", {"node_id": nid, "alive": False})
-        # Invalidate leases whose worker lived there.
+        # Invalidate leases whose worker lived there — same event + cause
+        # vocabulary as the single-worker death path (_lease_worker_died),
+        # so node-death failovers are queryable too.
+        from ray_tpu._private import events as _events
+
         for lease_id, ent in list(self.leases.items()):
             if ent["node_id"] == nid:
                 self._drop_lease(lease_id)  # node dead: release is a no-op
+                self._emit_event(
+                    "lease_failover",
+                    f"lease {lease_id[:8]} invalidated: node {nid[:8]} "
+                    f"died with worker {ent['worker_id'][:12]}; in-flight "
+                    f"specs fail over",
+                    entity=(lease_id, ent["worker_id"], ent["owner"], nid),
+                    node_id=nid, attrs={"cause": _events.CAUSE_CRASH})
                 oconn = self.client_conns.get(ent["owner"])
                 if oconn is not None and not oconn.closed:
                     try:
-                        await oconn.push("lease_invalid", lease_id=lease_id)
+                        await oconn.push("lease_invalid", lease_id=lease_id,
+                                         cause=_events.CAUSE_CRASH)
                     except Exception:
                         pass
         # Retry tasks that were running there.
@@ -3218,6 +3751,12 @@ class Controller:
                 job["status"] = "FAILED"
                 job["message"] = f"node {nid[:8]} hosting the job driver died"
                 job["end_time"] = time.time()
+                self._emit_event(
+                    "job_stop",
+                    f"job {job['submission_id']} -> FAILED (node {nid[:8]} "
+                    f"hosting the job driver died)", severity="warning",
+                    entity=(job["submission_id"], nid), node_id=nid,
+                    attrs={"status": "FAILED"})
         # Restart/kill its actors.
         for actor_id, ent in list(self.actors.items()):
             if ent.node_id == nid and ent.state in ("ALIVE", "PENDING", "RESTARTING"):
@@ -3238,7 +3777,7 @@ class Controller:
                         oid, ent,
                         f"device object {oid[:16]} lost: producing worker "
                         f"{(ent.device_worker or '?')[:12]} died with node "
-                        f"{nid[:8]}")
+                        f"{nid[:8]}" + self._event_hint(nid))
                 continue
             if ent.state != "ready" or ent.inline is not None:
                 continue

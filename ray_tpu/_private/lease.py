@@ -526,6 +526,18 @@ class LeaseManager:
             logger.warning(
                 "lease %s severed: failing %d in-flight spec(s) over to the "
                 "controller path", lease.lease_id[:8], len(failover))
+            # Owner-side event: when the direct connection drops BEFORE the
+            # controller hears of the worker's death, the owner is the only
+            # process that knows a failover happened (the controller may
+            # see only a routine lease return).
+            from ray_tpu._private import events as _events
+
+            _events.emit_event(
+                "lease_failover",
+                f"lease {lease.lease_id[:8]} severed: {len(failover)} "
+                f"in-flight spec(s) fail over to the controller path",
+                entity=(lease.lease_id, lease.worker_id),
+                attrs={"path": "owner_sever", "specs": len(failover)})
             self.w.submit_specs_via_controller(failover)
         if lease.cls.queue:
             self._pump(lease.cls)

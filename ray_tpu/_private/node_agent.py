@@ -19,6 +19,7 @@ import time
 from collections import deque
 from typing import Optional
 
+from ray_tpu._private import events as events_mod
 from ray_tpu._private import rpc, telemetry
 from ray_tpu._private.ids import WorkerID
 from ray_tpu._private.object_store import LocalStore
@@ -32,7 +33,8 @@ class _WorkerSlot:
     __slots__ = ("worker_id", "proc", "conn", "state", "task_id", "actor_id", "address",
                  "registered", "dedicated", "idle_since", "assigned_at",
                  "held_resources", "device_pinned",
-                 "beacon_task", "beacon_at", "beacon_silence")
+                 "beacon_task", "beacon_at", "beacon_silence",
+                 "exit_emitted")
 
     def __init__(self, worker_id: str, proc, dedicated: bool = False):
         self.worker_id = worker_id
@@ -62,6 +64,10 @@ class _WorkerSlot:
         self.beacon_task: Optional[str] = None
         self.beacon_at: float = 0.0
         self.beacon_silence: float = 0.0
+        # Event-plane dedup: exactly ONE worker_exit event per slot, no
+        # matter which order the exit paths fire in (reap tick vs
+        # _kill_slot vs idle reap vs OOM/stall report-then-kill).
+        self.exit_emitted = False
 
 
 class NodeAgent:
@@ -107,6 +113,16 @@ class NodeAgent:
         self._worker_device_series: dict[str, dict] = {}
         self._node_cpu: telemetry.CpuTracker | None = None
         self._worker_cpu: telemetry.PidCpuTracker | None = None
+        # Cluster event plane (README "Cluster events"): lifecycle events
+        # this agent observed (worker start/exit with normalized cause,
+        # dedup replays), awaiting the next heartbeat — or the next
+        # worker_died push, which carries them so an exit event's seq lands
+        # before the controller's restart/failover bookkeeping events.
+        # None when the plane is off (RT_EVENTS_BUFFER=0): the heartbeat
+        # frame stays byte-identical.
+        self._pending_events: deque | None = (
+            deque(maxlen=max(64, int(CONFIG.events_buffer)))
+            if int(CONFIG.events_buffer) > 0 else None)
         # Direct-path task dedup (at-most-once across owner failover): a
         # leased worker whose owner connection severed reports the spec it
         # is still running (`ltask_running`) and its eventual outcome
@@ -174,7 +190,21 @@ class NodeAgent:
         for t in self._tasks:
             t.cancel()
         for slot in list(self.workers.values()):
-            self._kill_slot(slot)
+            self._kill_slot(slot, cause=events_mod.CAUSE_SHUTDOWN,
+                            why="node agent shutdown")
+        # Final best-effort heartbeat carrying the shutdown worker_exits:
+        # the heartbeat loop is already cancelled, and undelivered events
+        # here would leave every worker_start without its exit pair when
+        # the controller outlives this agent.
+        evs = self._drain_events()
+        if evs and self.controller is not None and not self.controller.closed:
+            try:
+                await self.controller.push(
+                    "heartbeat", node_id=self.node_id,
+                    incarnation=self.incarnation,
+                    shm_used=self.store.shm_dir_usage(), events=evs)
+            except Exception:
+                pass
         await self.server.stop()
         if self.controller is not None:
             await self.controller.close()
@@ -272,6 +302,13 @@ class NodeAgent:
                 dup = await self._consume_direct_dup(spec.task_id,
                                                      spec.attempt)
                 if dup is not None:
+                    self._emit_event(
+                        "lease_dedup_replay",
+                        f"replayed recorded outcome for task "
+                        f"{spec.task_id[:12]} a{spec.attempt} (failover "
+                        f"re-dispatch absorbed; exactly-once)",
+                        entity=(spec.task_id, dup.get("worker_id")),
+                        attrs={"attempt": spec.attempt})
                     out = {"task_id": spec.task_id, "ok": True, "dup": True,
                            "worker_id": None, "results": dup.get("results"),
                            "error": dup.get("error"),
@@ -572,17 +609,27 @@ class NodeAgent:
         asyncio.ensure_future(_escalate())
         return {"stopped": True}
 
+    #: Per-call byte cap for job_logs replies (the PR 12 uniform truncation
+    #: discipline): an unbounded tail-from-offset read would buffer a whole
+    #: multi-GB log into ONE RPC reply frame. Callers loop while
+    #: `truncated` is true (job_submission._read_logs_from).
+    JOB_LOG_CHUNK_BYTES = 1 << 20
+
     def _job_logs(self, sid: str, offset: int) -> dict:
         ent = self.jobs.get(sid)
         if ent is None:
-            return {"data": b"", "offset": offset, "found": False}
+            return {"data": b"", "offset": offset, "found": False,
+                    "truncated": False}
         try:
             with open(ent["log_path"], "rb") as f:
                 f.seek(offset)
-                data = f.read(1 << 20)
-            return {"data": data, "offset": offset + len(data), "found": True}
+                data = f.read(self.JOB_LOG_CHUNK_BYTES)
+                truncated = bool(f.read(1))  # more bytes remain past the cap
+            return {"data": data, "offset": offset + len(data),
+                    "found": True, "truncated": truncated}
         except OSError:
-            return {"data": b"", "offset": offset, "found": False}
+            return {"data": b"", "offset": offset, "found": False,
+                    "truncated": False}
 
     async def _on_ctrl_push(self, conn, method, a):
         if method == "free":
@@ -615,6 +662,57 @@ class NodeAgent:
         elif method == "shutdown":
             await self.stop()
 
+    # ------------------------------------------------------- event plane
+    def _emit_event(self, kind: str, message: str = "", *,
+                    severity: str | None = None, entity=(),
+                    attrs: dict | None = None) -> None:
+        """Queue one lifecycle event; it rides the next heartbeat (or the
+        next worker_died push). No-op when the plane is off."""
+        if self._pending_events is None:
+            return
+        self._pending_events.append(events_mod.build_event(
+            kind, message, severity=severity, entity=entity,
+            node_id=self.node_id, attrs=attrs,
+            src=f"agent:{self.node_id[:12]}"))
+
+    def _emit_worker_exit(self, slot: _WorkerSlot, cause: str, reason: str,
+                          prev_state: str | None = None) -> None:
+        """Exactly one worker_exit event per slot, whichever exit path
+        observes it first (the slot-level flag dedups the report-then-kill
+        shapes: OOM/stall `_worker_exited` + `_kill_slot`, idle reap's
+        emit + kill)."""
+        if slot.exit_emitted:
+            return
+        slot.exit_emitted = True
+        self._emit_event(
+            "worker_exit",
+            f"worker {slot.worker_id[:12]} exited ({cause}): {reason}",
+            severity=("info" if cause in (events_mod.CAUSE_SHUTDOWN,
+                                          events_mod.CAUSE_IDLE_REAP)
+                      else "warning"),
+            entity=(slot.worker_id, slot.actor_id,
+                    slot.task_id if prev_state == "busy" else None),
+            attrs={"cause": cause, "state": prev_state or slot.state,
+                   "pid": slot.proc.pid})
+
+    def _drain_events(self) -> list | None:
+        if not self._pending_events:
+            return None
+        return [self._pending_events.popleft()
+                for _ in range(len(self._pending_events))]
+
+    @staticmethod
+    def _requeue_front(dq: deque | None, items: list | None) -> None:
+        """Requeue drained-but-unsent batches BEHIND anything appended
+        during the failed push (shed-oldest under a long outage). ONE
+        discipline for every heartbeat-piggybacked plane — the shared
+        rebuild lives in events.requeue_front; no lock here, the agent
+        loop owns both deques."""
+        events_mod.requeue_front(dq, items)
+
+    def _requeue_events(self, evs: list) -> None:
+        self._requeue_front(self._pending_events, evs)
+
     async def _heartbeat_loop(self):
         # ONE loop for the agent's lifetime: it reads self.controller every
         # beat, so it follows reconnects; failed pushes during an outage
@@ -623,6 +721,7 @@ class NodeAgent:
         while True:
             await asyncio.sleep(CONFIG.heartbeat_interval_s)
             telem = None
+            evs = None
             try:
                 beat = dict(node_id=self.node_id,
                             incarnation=self.incarnation,
@@ -637,17 +736,15 @@ class NodeAgent:
                     telem = [self._telem_pending.popleft()
                              for _ in range(len(self._telem_pending))]
                     beat["telemetry"] = telem
+                evs = self._drain_events()
+                if evs:  # frame unchanged when no lifecycle event is queued
+                    beat["events"] = evs
                 await self.controller.push("heartbeat", **beat)
             except Exception:
-                if telem and self._telem_pending is not None:
-                    # Controller away: requeue BEHIND anything the sampler
-                    # appended during the failed push, so the bounded
-                    # deque's append-side overflow sheds the OLDEST
-                    # batches under a long outage (extendleft would evict
-                    # the freshest instead).
-                    fresh = list(self._telem_pending)
-                    self._telem_pending.clear()
-                    self._telem_pending.extend(telem + fresh)
+                # Controller away: requeue both piggybacked planes for the
+                # next beat (shed-oldest discipline — see _requeue_front).
+                self._requeue_front(self._telem_pending, telem)
+                self._requeue_front(self._pending_events, evs)
                 continue
 
     # ----------------------------------------------------------- telemetry
@@ -832,7 +929,8 @@ class NodeAgent:
             if slot is not None and slot.state == "busy":
                 if slot.dedicated:
                     # One-shot worker (TPU task): the chip lease dies with it.
-                    self._kill_slot(slot)
+                    self._kill_slot(slot, cause=events_mod.CAUSE_SHUTDOWN,
+                                    why="one-shot dedicated worker finished")
                 else:
                     self._worker_became_idle(slot)
         elif method == "ltask_running":
@@ -1030,6 +1128,10 @@ class NodeAgent:
                          daemon=True, name=f"logs-{wid[:6]}").start()
         slot = _WorkerSlot(wid, proc, dedicated=dedicated)
         self.workers[wid] = slot
+        self._emit_event("worker_start",
+                         f"worker {wid[:12]} spawned (pid {proc.pid})",
+                         entity=(wid,),
+                         attrs={"pid": proc.pid, "dedicated": dedicated})
         return slot
 
     MAX_LOG_BUF_LINES = 1000
@@ -1086,7 +1188,16 @@ class NodeAgent:
                 except Exception:
                     pass
 
-    def _kill_slot(self, slot: _WorkerSlot):
+    def _kill_slot(self, slot: _WorkerSlot,
+                   cause: str = events_mod.CAUSE_KILLED,
+                   why: str = "explicit kill"):
+        # Kills that no worker_died report precedes (ray_tpu.kill routed
+        # via kill_worker, force-cancel, zombie reap) would otherwise leave
+        # the causal chain without its worker_exit link — the dead-state
+        # guards downstream skip the emission (the documented CAUSE_KILLED
+        # would be unreachable). Report-then-kill paths (OOM/stall) already
+        # emitted; the slot flag dedups.
+        self._emit_worker_exit(slot, cause, why)
         slot.state = "dead"
         try:
             slot.proc.terminate()
@@ -1200,17 +1311,26 @@ class NodeAgent:
                     # so any device entries it produced go cleanly LOST
                     # instead of pointing at a dead address forever.
                     # Plane off => no pins possible, reap stays silent.
-                    self._kill_slot(slot)
+                    self._kill_slot(slot, cause=events_mod.CAUSE_IDLE_REAP,
+                                    why=f"idle past {keep:.0f}s")
                     if CONFIG.device_objects:
+                        # Pending events ride this push too (like
+                        # _worker_exited's): the reap's worker_exit must
+                        # get its seq BEFORE the device_objects_lost
+                        # event this report's processing mints.
+                        evs = self._drain_events()
+                        kw = dict(worker_id=slot.worker_id,
+                                  task_id=None, actor_id=None,
+                                  reason="idle worker reaped",
+                                  cause=events_mod.CAUSE_IDLE_REAP,
+                                  node_id=self.node_id,
+                                  incarnation=self.incarnation)
+                        if evs:
+                            kw["events"] = evs
                         try:
-                            await self.controller.push(
-                                "worker_died", worker_id=slot.worker_id,
-                                task_id=None, actor_id=None,
-                                reason="idle worker reaped", cause=None,
-                                node_id=self.node_id,
-                                incarnation=self.incarnation)
+                            await self.controller.push("worker_died", **kw)
                         except Exception:
-                            pass
+                            self._requeue_events(evs or [])
 
     async def _worker_exited(self, slot: _WorkerSlot, reason: str,
                              cause: str | None = None):
@@ -1233,10 +1353,16 @@ class NodeAgent:
         self.workers.pop(slot.worker_id, None)
         self._purge_direct_tasks(slot.worker_id)
         self._worker_device_series.pop(slot.worker_id, None)
+        # ONE cause vocabulary for every exit path (README "Cluster
+        # events"): the reap loop's raw exit codes, the OOM/stall kills,
+        # and the idle reaper all collapse into events.EXIT_CAUSES, so the
+        # worker_died report, the worker_exit event, and the owner-side
+        # failure message all agree.
+        cause = events_mod.normalize_exit_cause(cause, reason)
+        self._emit_worker_exit(slot, cause, reason, prev_state)
         if prev_state in ("busy", "actor", "leased") or slot.actor_id:
             try:
-                await self.controller.push(
-                    "worker_died",
+                kw = dict(
                     worker_id=slot.worker_id,
                     task_id=slot.task_id if prev_state == "busy" else None,
                     actor_id=slot.actor_id,
@@ -1245,6 +1371,19 @@ class NodeAgent:
                     node_id=self.node_id,
                     incarnation=self.incarnation,
                 )
+                # The pending events (incl. this exit's) ride the report
+                # itself: the controller ingests them BEFORE minting its
+                # restart/failover events, so causal chains stay ordered
+                # under arrival-order seq minting.
+                evs = self._drain_events()
+                if evs:
+                    kw["events"] = evs
+                try:
+                    await self.controller.push("worker_died", **kw)
+                except Exception:
+                    if evs:
+                        self._requeue_events(evs)  # next heartbeat delivers
+                    raise
             except Exception:
                 pass
 

@@ -226,6 +226,26 @@ _flag("profile_hz", int, 100)
 # Storage-plane URI captured profiles persist under (any backend);
 # "" = <session_dir>/<session>/profiles.
 _flag("profile_dir", str, "")
+# --- cluster event plane (README "Cluster events") --------------------------
+# Ring capacity for lifecycle events: the controller's arrival-order ring,
+# each process's emission buffer, and the node agents' heartbeat-piggyback
+# deques are all bounded by this. 0 disables the plane entirely (no rings,
+# no `events=` keys on any frame); the default keeps it always-on — events
+# are emitted at lifecycle-transition rate, never on the per-task hot path
+# (pinned by the bench `events_overhead` lane).
+_flag("events_buffer", int, 2048)
+# Persist settled events through the storage plane as segmented JSONL under
+# events_dir, so history survives controller restarts. False = in-memory
+# ring only.
+_flag("events_persist", bool, True)
+# Storage-plane URI event segments land under (any backend: local://,
+# mem://, sim://, bare path); "" = <session_dir>/<session>/events.
+_flag("events_dir", str, "")
+# Events per JSONL segment: a full segment is written once and never
+# rewritten; the in-progress tail rewrites atomically each sweep tick.
+_flag("events_segment_events", int, 512)
+# Keep-last-K segment rotation: oldest segments beyond this are deleted.
+_flag("events_keep_segments", int, 16)
 # --- serving hot loop (README "Serving hot loop") ---------------------------
 # Token-batch stream ring: streaming serve responses (SSE) ride a shm
 # StreamRing from the replica straight to the HTTP proxy — one host hop

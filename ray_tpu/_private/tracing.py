@@ -74,7 +74,6 @@ _ring_lock = threading.Lock()
 _flusher_kicked = False
 
 _pid = os.getpid()
-_proc_label: Optional[str] = None
 
 
 def enabled() -> bool:
@@ -150,19 +149,11 @@ def _get_ring() -> deque:
 
 
 def _label() -> str:
-    global _proc_label
-    lbl = _proc_label
-    if lbl is None:
-        try:
-            from ray_tpu._private.worker import global_worker
+    # Shared with the event plane: one worker-id/pidN resolution (and its
+    # pidN-never-cached upgrade subtlety) for span AND event records.
+    from ray_tpu._private import events as _events
 
-            w = global_worker()
-            lbl = w.worker_id[:12] if w is not None else f"pid{_pid}"
-        except Exception:
-            lbl = f"pid{_pid}"
-        if not lbl.startswith("pid"):
-            _proc_label = lbl  # worker id is stable; pidN may upgrade later
-    return lbl
+    return _events.proc_label()
 
 
 def record_span(trace_id: str, span_id: str, parent: Optional[str],
@@ -212,26 +203,20 @@ def record_instant(wire_ctx: Optional[tuple], name: str, kind: str,
 
 def drain() -> list:
     """Pop all buffered spans (called from the metrics flusher)."""
-    ring = _ring
-    if not ring:
-        return []
-    out = []
-    try:
-        while True:
-            out.append(ring.popleft())
-    except IndexError:
-        pass
-    return out
+    from ray_tpu._private import events as _events
+
+    return _events.drain_ring(_ring)
 
 
 def requeue(spans: list) -> None:
     """Put drained-but-unsent spans back at the FRONT of the ring in their
     original order (the metrics flusher raced a shutdown and could not
-    push) so the forced final flush still delivers them."""
-    ring = _ring
-    if ring is None or not spans:
-        return
-    ring.extendleft(reversed(spans))
+    push) so the forced final flush still delivers them. Shares the
+    events-plane shed-oldest rebuild (locked: the engine scheduler and
+    checkpoint writer record spans from other threads)."""
+    from ray_tpu._private import events as _events
+
+    _events.requeue_front(_ring, spans, _ring_lock)
 
 
 # ------------------------------------------------------------- propagation

@@ -487,8 +487,13 @@ class Worker:
 
         self.io.run(_go(), timeout=CONFIG.connect_timeout_s)
         # Tracing plane: re-resolve RT_TRACING now the cluster snapshot is
-        # in (and arm/disarm the rpc frame hook accordingly).
+        # in (and arm/disarm the rpc frame hook accordingly). The event
+        # plane re-resolves the same way (RT_EVENTS_BUFFER=0 via
+        # _system_config must reach every process).
         _tracing.refresh()
+        from ray_tpu._private import events as _events
+
+        _events.refresh()
 
     def disconnect(self):
         self._shutdown = True

@@ -44,9 +44,11 @@ _INDEX_HTML = """<!doctype html><html><head><title>ray_tpu dashboard</title>
 <h2>Nodes</h2><table id="nodes"></table>
 <h2>Actors</h2><table id="actors"></table>
 <h2>Jobs</h2><table id="jobs"></table>
+<h2>Recent events</h2><table id="events"></table>
 <h2>Recent tasks</h2><table id="tasks"></table>
 <p><a href="/api/timeline">timeline</a> (chrome trace; load in Perfetto) &middot;
 <a href="/api/traces">traces</a> (causal spans; RT_TRACING=1) &middot;
+<a href="/api/events">events</a> (lifecycle history; ray-tpu events) &middot;
 <a href="/api/timeseries">timeseries</a> (RT_TELEMETRY_INTERVAL_S) &middot;
 <a href="/api/profiles">profiles</a> (ray-tpu profile) &middot;
 <a href="/metrics">prometheus /metrics</a></p>
@@ -103,9 +105,9 @@ async function util(){ // live sparkline row (RT_TELEMETRY_INTERVAL_S armed)
 async function tick(){
   util();
   try{
-    const [st,nodes,actors,jobs,tasks]=await Promise.all([
+    const [st,nodes,actors,jobs,tasks,events]=await Promise.all([
       j("/api/cluster_status"),j("/api/nodes"),j("/api/actors"),
-      j("/api/jobs"),j("/api/tasks?limit=25")]);
+      j("/api/jobs"),j("/api/tasks?limit=25"),j("/api/events?limit=15")]);
     document.getElementById("meta").textContent=
       "updated "+new Date().toLocaleTimeString();
     const tot=st.total||{},av=st.available||{};
@@ -114,6 +116,10 @@ async function tick(){
     table("nodes",nodes.nodes||[],["node_id","alive","address","total","available"]);
     table("actors",actors.actors||[],["actor_id","class","state","name","node_id","restarts_used"]);
     table("jobs",jobs.jobs||[],["submission_id","status","entrypoint","message"]);
+    const erows=(events.events||[]).slice(-15).reverse().map(e=>({...e,
+      time:new Date((e.ts||0)*1000).toLocaleTimeString(),
+      entity:(e.entity||[]).map(x=>String(x).slice(0,12)).join(",")}));
+    table("events",erows,["seq","time","sev","kind","entity","msg"]);
     const trows=(tasks.tasks||[]).slice(-25).reverse().map(t=>({...t,
       duration_ms:(t.end&&t.start)?Math.round((t.end-t.start)*1000):""}));
     table("tasks",trows,["name","kind","state","duration_ms","node_id"]);
@@ -231,6 +237,7 @@ class Dashboard:
             app.router.add_get("/api/tasks", self._tasks)
             app.router.add_get("/api/objects", self._objects)
             app.router.add_get("/api/jobs", self._jobs)
+            app.router.add_get("/api/events", self._events)
             app.router.add_get("/api/timeline", self._timeline)
             app.router.add_get("/api/timeseries", self._timeseries)
             app.router.add_get("/api/profiles", self._profiles)
@@ -332,6 +339,21 @@ class Dashboard:
                 {"error": "worker_id query param required"}, status=400)
         rep = await self._a_call("worker_stacks", worker_id=wid,
                                  node_id=request.query.get("node_id"))
+        return web.json_response(rep)
+
+    async def _events(self, request):
+        """Cluster event plane (README "Cluster events"):
+        /api/events?entity=&kind=&severity=&since=&limit= — lifecycle
+        history with seq-cursor polling (`next_seq` in the reply)."""
+        from aiohttp import web
+
+        kw: dict = {"limit": int(request.query.get("limit", 1000))}
+        for key in ("entity", "kind", "severity"):
+            if request.query.get(key):
+                kw[key] = request.query[key]
+        if request.query.get("since"):
+            kw["since"] = int(request.query["since"])
+        rep = await self._a_call("list_events", **kw)
         return web.json_response(rep)
 
     async def _timeseries(self, request):

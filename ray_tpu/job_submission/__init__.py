@@ -101,15 +101,19 @@ class JobSubmissionClient:
         return bool(self._call("stop_job", submission_id=submission_id)["stopped"])
 
     def _read_logs_from(self, submission_id: str, offset: int) -> tuple[bytes, int]:
-        """Read to EOF (the agent serves at most 1 MiB per RPC)."""
+        """Read to EOF. The agent caps each reply (JOB_LOG_CHUNK_BYTES) and
+        marks clipped ones `truncated: true`; loop on the marker so a large
+        log arrives whole without ever riding one unbounded RPC frame."""
         chunks = []
         while True:
             rep = self._call("job_logs", submission_id=submission_id, offset=offset)
             data = bytes(rep["data"])
             offset = rep["offset"]
-            if not data:
+            if data:
+                chunks.append(data)
+            if not rep.get("truncated", bool(data)):
+                # Marker-less legacy replies fall back to read-until-empty.
                 return b"".join(chunks), offset
-            chunks.append(data)
 
     def get_job_logs(self, submission_id: str) -> str:
         data, _ = self._read_logs_from(submission_id, 0)

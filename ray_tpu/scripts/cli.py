@@ -378,6 +378,77 @@ def cmd_stalls(args) -> int:
     return 0
 
 
+def _print_event_rows(rows: list, verbose: bool) -> None:
+    for r in rows:
+        ent = ",".join(str(e)[:12] for e in (r.get("entity") or [])) or "-"
+        ts = time.strftime("%H:%M:%S", time.localtime(r.get("ts") or 0))
+        print(f"{r.get('seq', '-'):>7} {ts} "
+              f"{(r.get('sev') or '-'):<8} "
+              f"{(r.get('kind') or '-'):<20} "
+              f"{str(r.get('node') or '-')[:10]:<10} "
+              f"{ent:<26} "
+              f"{(r.get('msg') or '')[:70]}")
+        if r.get("trace_id"):
+            print(f"        trace: {r['trace_id']}  "
+                  f"(ray-tpu timeline --trace {str(r['trace_id'])[:12]})")
+        if verbose and r.get("attrs"):
+            print(f"        {r['attrs']}")
+
+
+def cmd_events(args) -> int:
+    """`ray-tpu events` — the cluster event plane (README "Cluster
+    events"): durable lifecycle history. Lists events newest-last; filter
+    with --entity (prefix-matches actor/worker/task/lease/node/job ids),
+    --kind, --severity; --follow polls for new seqs (the controller reply's
+    next_seq cursor). Stall events print their trace link so
+    `ray-tpu events` -> `ray-tpu timeline --trace` chains."""
+    kw: dict = {"limit": args.limit}
+    if args.entity:
+        kw["entity"] = args.entity
+    if args.kind:
+        kw["kind"] = args.kind
+    if args.severity:
+        kw["severity"] = args.severity
+    header = (f"{'SEQ':>7} {'TIME':<8} {'SEV':<8} {'KIND':<20} "
+              f"{'NODE':<10} {'ENTITY':<26} MESSAGE")
+    if not args.follow:
+        rep = _rpc_call(_resolve_address(args), "list_events", **kw)
+        rows = rep["events"]
+        if not rows:
+            print("no events recorded (plane disabled? arm with "
+                  "RT_EVENTS_BUFFER > 0 — the default)")
+            return 0
+        print(header)
+        _print_event_rows(rows, args.verbose)
+        if rep.get("truncated"):
+            print(f"(truncated to the newest {args.limit}; raise --limit)")
+        return 0
+    client = _Client(_resolve_address(args))
+    since = None
+    try:
+        print(header)
+        while True:
+            rep = client.call("list_events",
+                              **({**kw, "since": since} if since is not None
+                                 else kw))
+            _print_event_rows(rep["events"], args.verbose)
+            if rep.get("truncated"):
+                # Never a silently short answer: a burst bigger than
+                # --limit between polls drops its oldest rows — say so.
+                print(f"(burst exceeded --limit {args.limit}; oldest "
+                      f"rows of this poll were dropped)")
+            # next_seq is the next seq the controller will MINT; the last
+            # seen seq is one below it (since= is exclusive).
+            nxt = rep.get("next_seq")
+            if nxt is not None:
+                since = nxt - 1
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        client.close()
+
+
 def _fmt_bytes(n) -> str:
     if n is None:
         return "-"
@@ -730,6 +801,35 @@ def main(argv=None) -> int:
     pl.add_argument("--verbose", action="store_true",
                     help="show flight-recorder tails and dump paths")
     pl.set_defaults(fn=cmd_stalls)
+
+    pe = sub.add_parser(
+        "events",
+        help="list cluster lifecycle events (the durable event plane)",
+        description="List the cluster event plane's lifecycle history: "
+                    "node register/SUSPECT/dead, worker start/exit with "
+                    "normalized cause, actor create/restart/death, lease "
+                    "failover + dedup replay, device-object producer loss, "
+                    "checkpoint commit/GC, train group restarts, serve "
+                    "deploy/scale/replica death, job start/stop, and every "
+                    "stall-escalation stage (with its trace link). Events "
+                    "persist under <session>/events/ as segmented JSONL "
+                    "and survive controller restarts.")
+    pe.add_argument("--address", default=None)
+    pe.add_argument("--entity", default=None,
+                    help="filter: prefix-match any entity id (actor/worker/"
+                         "task/lease/node/job)")
+    pe.add_argument("--kind", default=None,
+                    help="filter: one event kind (see the README kind table)")
+    pe.add_argument("--severity", default=None,
+                    choices=("debug", "info", "warning", "error"))
+    pe.add_argument("--limit", type=int, default=1000)
+    pe.add_argument("--follow", action="store_true",
+                    help="poll for new events (seq cursor) until ^C")
+    pe.add_argument("--interval", type=float, default=1.0,
+                    help="--follow poll period seconds (default 1)")
+    pe.add_argument("--verbose", action="store_true",
+                    help="also print each event's attrs dict")
+    pe.set_defaults(fn=cmd_events)
 
     pm = sub.add_parser(
         "timeline",

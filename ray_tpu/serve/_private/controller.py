@@ -22,6 +22,7 @@ import uuid
 from typing import Any, Optional
 
 import ray_tpu
+from ray_tpu._private.events import emit_event
 
 logger = logging.getLogger(__name__)
 
@@ -121,6 +122,13 @@ class ServeController:
         if prefix:
             self.routes = {p: d for p, d in self.routes.items() if d != name}
             self.routes[prefix] = name
+        emit_event("serve_deploy",
+                   f"deployment {name!r} "
+                   f"{'updated' if cur is not None else 'created'} "
+                   f"(target {self.deployments[name].target})",
+                   entity=(name,),
+                   attrs={"target": self.deployments[name].target,
+                          "update": cur is not None})
         self._bump()
 
     async def get_routing(self, deployment: str, known_version: int = -1,
@@ -213,6 +221,9 @@ class ServeController:
                     and st.replicas.pop(rid, None) is not None):
                 logger.warning("serve: replica %s failed health check (%r); "
                                "replacing", rid, e)
+                emit_event("serve_replica_death",
+                           f"replica {rid} failed its health check ({e!r}); "
+                           f"replacing", entity=(name, rid))
                 self._bump()
                 # Actually stop it: a live-but-stuck replica would otherwise
                 # keep its actor + resource reservation forever, starving
@@ -252,6 +263,9 @@ class ServeController:
                 else:
                     logger.warning("serve: replica %s failed to start: %r",
                                    rid, err)
+                    emit_event("serve_replica_death",
+                               f"replica {rid} failed to start: {err!r}",
+                               entity=(name, rid), attrs={"start": True})
                     st.replicas.pop(rid, None)
         # The executor hops above are suspension points the old sync
         # wait/get never had: a deploy() landing mid-await swaps
@@ -321,6 +335,10 @@ class ServeController:
         # and a waiting client must not override that.
         if st.target < 1 and self._scale_to_zero_ok(st):
             logger.info("serve: scale-from-zero %s (router demand)", name)
+            emit_event("serve_scale",
+                       f"deployment {name!r} scale-from-zero 0 -> 1 "
+                       f"(router demand)", entity=(name,),
+                       attrs={"from": 0, "to": 1, "why": "demand"})
             st.target = 1
             st.low_ticks = 0
         return True
@@ -358,6 +376,11 @@ class ServeController:
         if desired > st.target:
             logger.info("serve: autoscale %s %d -> %d (ongoing=%d)",
                         name, st.target, desired, total)
+            emit_event("serve_scale",
+                       f"deployment {name!r} autoscale {st.target} -> "
+                       f"{desired} (ongoing={total})", entity=(name,),
+                       attrs={"from": st.target, "to": desired,
+                              "ongoing": total})
             st.target = desired
             st.low_ticks = 0
         elif desired < st.target:
@@ -365,6 +388,11 @@ class ServeController:
             if st.low_ticks >= DOWNSCALE_PATIENCE:
                 logger.info("serve: autoscale %s %d -> %d (ongoing=%d)",
                             name, st.target, desired, total)
+                emit_event("serve_scale",
+                           f"deployment {name!r} autoscale {st.target} -> "
+                           f"{desired} (ongoing={total})", entity=(name,),
+                           attrs={"from": st.target, "to": desired,
+                                  "ongoing": total})
                 st.target = desired
                 st.low_ticks = 0
         else:

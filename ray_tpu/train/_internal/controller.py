@@ -174,6 +174,19 @@ class TrainController:
                 )
             logger.warning("train group failure %d (%s); restarting from %s",
                            self.failures, outcome["error"], self.latest_checkpoint)
+            try:
+                from ray_tpu._private.events import emit_event
+
+                emit_event(
+                    "train_restart",
+                    f"train group {self.run_name!r} failure "
+                    f"{self.failures} ({str(outcome['error'])[:120]}); "
+                    f"restarting from "
+                    f"{getattr(self.latest_checkpoint, 'path', None)}",
+                    entity=(self.run_name,),
+                    attrs={"failures": self.failures, "attempt": attempt})
+            except Exception:
+                pass
 
     def _drain(self, group: WorkerGroup) -> int:
         """Drain worker reports into history; returns how many landed —

@@ -590,11 +590,29 @@ def _commit(d: str, rank: int, manifest: dict, t0: float,
     stats["commit_s"] = elapsed
     _mint_metrics(manifest, elapsed)
     _register_with_controller(d, manifest)
+    from ray_tpu._private.events import emit_event
+
+    try:
+        emit_event("checkpoint_commit",
+                   f"checkpoint committed at {d} (step "
+                   f"{manifest.get('step')}, {manifest.get('bytes')} bytes)",
+                   entity=(d,),
+                   attrs={"step": manifest.get("step"),
+                          "bytes": manifest.get("bytes"),
+                          "commit_s": round(elapsed, 3)})
+    except Exception:
+        pass
     parent = storage.parent(d)
     keep = CONFIG.ckpt_keep
     if keep:
         try:
-            retention(parent, keep)
+            deleted = retention(parent, keep)
+            if deleted:
+                emit_event("checkpoint_gc",
+                           f"retention deleted {len(deleted)} checkpoint(s) "
+                           f"under {parent} (keep-last-{keep})",
+                           entity=(parent,),
+                           attrs={"deleted": len(deleted)})
         except Exception:
             logger.exception("checkpoint retention failed under %s", parent)
     try:

@@ -57,19 +57,29 @@ def _flush_now(force: bool = False):
             spans = _tr.drain() or None
         except Exception:
             spans = None
+    # Cluster lifecycle events ride the same batches (`events=` key —
+    # README "Cluster events"), with the same sys.modules gate: a process
+    # that never emitted must not import (or pay for) the events module.
+    events = None
+    _ev = sys.modules.get("ray_tpu._private.events")
+    if _ev is not None:
+        try:
+            events = _ev.drain() or None
+        except Exception:
+            events = None
     with _lock:
         global _pending
         batch, _pending = _pending, []
-    if not batch and not spans:
+    if not batch and not spans and not events:
         return
     w = global_worker()
     if w is None or (getattr(w, "_shutdown", False) and not force):
         if w is not None:
             # A background tick racing Worker.disconnect between its
             # `_shutdown = True` and flush_on_shutdown(): put the drained
-            # records/spans BACK so the force flush still finds them —
-            # silently dropping here would re-open the tail-loss hole this
-            # path exists to close.
+            # records/spans/events BACK so the force flush still finds them
+            # — silently dropping here would re-open the tail-loss hole
+            # this path exists to close.
             with _lock:
                 _pending[:0] = batch
             if spans and _tr is not None:
@@ -77,13 +87,19 @@ def _flush_now(force: bool = False):
                     _tr.requeue(spans)
                 except Exception:
                     pass
+            if events and _ev is not None:
+                try:
+                    _ev.requeue(events)
+                except Exception:
+                    pass
         return
     try:
+        kw: dict = {"records": batch}
         if spans is not None:
-            w.controller.push_threadsafe("metrics_report", records=batch,
-                                         spans=spans)
-        else:
-            w.controller.push_threadsafe("metrics_report", records=batch)
+            kw["spans"] = spans
+        if events is not None:
+            kw["events"] = events
+        w.controller.push_threadsafe("metrics_report", **kw)
     except Exception:
         pass
 

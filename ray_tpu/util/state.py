@@ -101,6 +101,31 @@ def list_stalls(limit: int = 1000) -> list[dict]:
     return _rows(_call("list_stalls", limit=limit), "stalls")
 
 
+def list_events(entity: str | None = None, kind: str | None = None,
+                severity: str | None = None, since: int | None = None,
+                limit: int = 1000) -> list[dict]:
+    """Cluster lifecycle events (README "Cluster events"): one row per
+    transition the runtime observed — node register/suspect/dead, worker
+    start/exit (with normalized cause), actor create/restart/death, lease
+    failover and dedup replay, device-object producer loss, checkpoint
+    commit/GC, train group restarts, serve deploy/scale/replica death,
+    job start/stop, and every stall-escalation stage (carrying the stalled
+    task's trace_id). Rows are seq-ordered (controller arrival order).
+    `entity=` prefix-matches ANY of an event's entity ids (actor/worker/
+    task/lease/node/job ids); `since=` is a seq (exclusive) for follow-
+    style polling; `.truncated` marks a limit-clipped reply."""
+    kw: dict = {"limit": limit}
+    if entity is not None:
+        kw["entity"] = entity
+    if kind is not None:
+        kw["kind"] = kind
+    if severity is not None:
+        kw["severity"] = severity
+    if since is not None:
+        kw["since"] = since
+    return _rows(_call("list_events", **kw), "events")
+
+
 def list_traces(limit: int = 1000) -> list[dict]:
     """Traces the controller has indexed (README "Tracing & timeline"):
     one row per trace_id — root name, start/end, span count, and whether

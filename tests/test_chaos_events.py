@@ -1,0 +1,230 @@
+"""Chaos coverage for the cluster event plane (README "Cluster events"):
+kill a worker mid-task and the full causal chain appears ordered and
+entity-indexed within the detection deadline; stall kills carry the
+stalled task's trace_id; the ring stays bounded under churn and
+persistence heals after a severed sim:// backend."""
+
+import os
+import signal
+import time
+
+import ray_tpu
+from ray_tpu._private import events as events_mod
+from ray_tpu.util import state
+
+
+def _wait_for(pred, timeout=25.0, interval=0.2, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        out = pred()
+        if out:
+            return out
+        time.sleep(interval)
+    raise TimeoutError(f"timed out waiting for {what}")
+
+
+def test_actor_kill_causal_event_chain(ray_start_2cpu):
+    """SIGKILL an actor's worker mid-life: the replacement comes up and
+    `list_events(entity=actor_id)` shows the ordered, entity-linked chain
+    worker_exit{cause=crash} -> actor_restart -> actor_ready."""
+    @ray_tpu.remote(max_restarts=1, max_task_retries=2)
+    class Phoenix:
+        def pid(self):
+            return os.getpid()
+
+    p = Phoenix.remote()
+    pid = ray_tpu.get(p.pid.remote(), timeout=60)
+    t0 = time.monotonic()
+    os.kill(pid, signal.SIGKILL)
+    # The replacement serves again — and the chain is queryable.
+    pid2 = ray_tpu.get(p.pid.remote(), timeout=60)
+    assert pid2 != pid
+
+    def _chain():
+        rows = state.list_events(entity=p._actor_id)
+        kinds = [e["kind"] for e in rows]
+        if "worker_exit" in kinds and "actor_restart" in kinds \
+                and kinds.count("actor_ready") >= 2:
+            return rows
+        return None
+
+    rows = _wait_for(_chain, what="causal event chain")
+    detect_s = time.monotonic() - t0
+    assert detect_s < 20, f"chain took {detect_s:.1f}s to appear"
+    by_kind = {}
+    for e in rows:
+        by_kind.setdefault(e["kind"], []).append(e)
+    exit_ev = by_kind["worker_exit"][0]
+    restart_ev = by_kind["actor_restart"][0]
+    ready_ev = by_kind["actor_ready"][-1]
+    # Ordered by seq (the worker_died push carries the agent's exit event,
+    # so arrival-order seqs preserve causality).
+    assert exit_ev["seq"] < restart_ev["seq"] < ready_ev["seq"], rows
+    # Normalized cause + entity linkage on every link of the chain.
+    assert exit_ev["attrs"]["cause"] == events_mod.CAUSE_CRASH
+    assert any(str(x).startswith(p._actor_id[:12])
+               for x in exit_ev["entity"])
+    assert restart_ev["sev"] == "warning"
+
+
+def test_leased_worker_kill_emits_lease_failover(ray_start_2cpu):
+    """Kill a LEASED worker mid-plain-task: the lease invalidates (specs
+    fail over, task still completes via retry) and the event chain shows
+    worker_exit -> lease_failover with the shared cause enum."""
+    @ray_tpu.remote
+    def slow(i):
+        time.sleep(0.4)
+        return i
+
+    refs = [slow.remote(i) for i in range(8)]
+
+    def _leased_pid():
+        for slot in ray_tpu._head.agent.workers.values():
+            if slot.state == "leased" and slot.proc.poll() is None:
+                return slot.proc.pid
+        return None
+
+    pid = _wait_for(_leased_pid, what="a leased worker")
+    os.kill(pid, signal.SIGKILL)
+    # Retries absorb the kill: every task still completes.
+    assert sorted(ray_tpu.get(refs, timeout=120)) == list(range(8))
+
+    def _failover():
+        exits = [e for e in state.list_events(kind="worker_exit")
+                 if (e.get("attrs") or {}).get("pid") == pid]
+        fails = state.list_events(kind="lease_failover")
+        return (exits, fails) if exits and fails else None
+
+    exits, fails = _wait_for(_failover, what="worker_exit + lease_failover")
+    assert exits[0]["attrs"]["cause"] == events_mod.CAUSE_CRASH
+    # Whichever side observed the failover first (the owner's severed
+    # direct conn, or the controller's worker_died), the event names the
+    # dead worker so the chain is entity-linked.
+    wid = exits[0]["entity"][0]
+    assert any(any(str(x).startswith(str(wid)[:12]) for x in e["entity"])
+               for e in fails), (exits, fails)
+
+
+def test_stall_kill_event_carries_trace_id(shutdown_only, tmp_path):
+    """Acceptance: stall-kill events carry the trace_id of the stalled
+    task, chaining `ray-tpu events` -> `ray-tpu timeline --trace`."""
+    ray_tpu.init(num_cpus=2, _system_config={
+        "tracing": True,
+        "stall_warn_s": 0.6,
+        "stall_kill_s": 1.5,
+        "stall_beacon_interval_s": 0.2,
+    })
+    marker = str(tmp_path / "attempt")
+
+    @ray_tpu.remote
+    def wedge(path):
+        import os as _os
+        import time as _t
+
+        n = int(open(path).read()) if _os.path.exists(path) else 0
+        with open(path, "w") as f:
+            f.write(str(n + 1))
+        if n == 0:
+            _t.sleep(120)  # silent stall on the first attempt
+        return n + 1
+
+    assert ray_tpu.get(wedge.remote(marker), timeout=60) == 2
+
+    def _kill_event():
+        rows = [e for e in state.list_events(kind="stall")
+                if (e.get("attrs") or {}).get("stage") == "kill"]
+        return rows or None
+
+    rows = _wait_for(_kill_event, what="stall kill event")
+    ev = rows[0]
+    assert ev["sev"] == "error"
+    assert ev.get("trace_id"), "stall-kill event lost its trace linkage"
+    # The trace is resolvable — the events -> timeline chain works.
+    tr = state.get_trace(ev["trace_id"])
+    assert tr.get("found"), tr
+
+
+def test_event_ring_churn_bounded_and_persistence_heals(
+        shutdown_only, tmp_path, monkeypatch):
+    """10k-event churn: bounded controller memory, keep-last-K segment
+    rotation, and a severed sim:// backend sheds (counted) then persists
+    again once healed."""
+    from ray_tpu import storage
+
+    ev_dir = "sim://" + str(tmp_path / "ev")
+    monkeypatch.setenv("RT_EVENTS_DIR", ev_dir)
+    monkeypatch.setenv("RT_EVENTS_BUFFER", "256")
+    monkeypatch.setenv("RT_EVENTS_SEGMENT_EVENTS", "64")
+    monkeypatch.setenv("RT_EVENTS_KEEP_SEGMENTS", "3")
+    events_mod.refresh()
+    storage.sim.faults().clear()
+    try:
+        ray_tpu.init(num_cpus=1)
+        head = ray_tpu._head
+        ctrl = head.controller
+
+        async def _pump(n, tag):
+            ctrl._ingest_events([
+                events_mod.build_event("job_start", f"{tag} {i}",
+                                       entity=(f"{tag}{i % 97}",))
+            for i in range(n)])
+
+        for _ in range(10):
+            head.io.run(_pump(1000, "churn"))
+        # Bounded memory: the arrival ring holds exactly the cap; the
+        # persistence backlog is capped too; the entity index is capped.
+        assert len(ctrl.events) == 256
+        assert len(ctrl._evseg_buf) <= 256
+        assert len(ctrl._event_index) <= ctrl._EVENT_INDEX_ENTITIES
+        assert ctrl._event_seq >= 10_000
+        # Oldest rotated out: the ring starts well past seq 0, and the
+        # list API serves only the retained window (truncated flagged).
+        assert ctrl.events[0]["seq"] >= 10_000 - 256
+        rep = state.list_events(limit=100)
+        assert rep.truncated and len(rep) == 100
+
+        def _segments():
+            try:
+                return [n for n in storage.listdir(ev_dir)
+                        if n.startswith("seg-")]
+            except Exception:
+                return []
+
+        _wait_for(lambda: _segments() or None, what="first segments")
+        assert len(_segments()) <= 3  # keep-last-K
+
+        # --- sever the backend mid-stream ------------------------------
+        storage.sim.faults().sever()
+        head.io.run(_pump(50, "severed"))
+        time.sleep(2.5)  # sweeps fail; buffer retains/sheds, never crashes
+        assert ctrl._event_seq >= 10_050
+        # --- heal: persistence picks up where it left off --------------
+        storage.sim.faults().restore()
+        head.io.run(_pump(10, "healed"))
+        target = ctrl._event_seq - 1
+
+        def _persisted_past_target():
+            try:
+                names = storage.listdir(ev_dir)
+            except Exception:
+                return False
+            hi = -1
+            for n in names:
+                if n.startswith("seg-"):
+                    hi = max(hi, int(n[len("seg-"):-len(".jsonl")]))
+            if hi >= target:
+                return True
+            try:
+                import json as _json
+
+                lines = storage.get_bytes(
+                    storage.join(ev_dir, "current.jsonl")).splitlines()
+                return bool(lines) and _json.loads(
+                    lines[-1])["seq"] >= target
+            except Exception:
+                return False
+
+        _wait_for(_persisted_past_target, what="post-heal persistence")
+    finally:
+        storage.sim.faults().clear()
+        events_mod.refresh()

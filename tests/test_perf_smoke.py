@@ -99,6 +99,19 @@ def test_bench_smoke_runs():
         f"armed telemetry costs {rep['details']['telemetry_overhead']}x "
         f"(off {m_off}/s vs on {m_on}/s medians) — budget is 1.05x "
         f"(noise-widened gate: {m_bound}x)")
+    # Event plane A/B (README "Cluster events"): emission is always-on by
+    # default, so the default-on driver task hot path must sit within the
+    # noise bound of RT_EVENTS_BUFFER=0 — nothing on the per-task path
+    # emits; lifecycle transitions are orders of magnitude rarer.
+    e_off = rep["details"].get("events_off_tasks_s")
+    e_on = rep["details"].get("events_on_tasks_s")
+    assert e_off and e_on, (
+        "events_overhead A/B missing (bench skipped it: see its stderr)")
+    e_bound = rep["details"]["events_overhead_bound"]
+    assert rep["details"]["events_overhead"] <= e_bound, (
+        f"always-on event plane costs {rep['details']['events_overhead']}x "
+        f"(off {e_off}/s vs on {e_on}/s medians) — budget is 1.05x "
+        f"(noise-widened gate: {e_bound}x)")
     # Serving hot loop (ISSUE 13 acceptance): end-to-end SSE streaming
     # decode under 4 concurrent clients must hold >= 0.5x of the SAME
     # engine's isolated rate (vs ~0.045x on the per-token reply path the

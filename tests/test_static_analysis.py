@@ -672,4 +672,79 @@ def test_rtcheck_cli_json():
 def test_every_pass_registered():
     ids = {p.id for p in core.all_passes()}
     assert ids == {"async-blocking", "wire-schema", "knob-registry",
-                   "lock-discipline", "exception-taxonomy"}
+                   "lock-discipline", "exception-taxonomy", "event-kinds"}
+
+
+# -------------------------------------------------------------- event-kinds
+_EVENTS_REGISTRY = """
+    KINDS = {
+        "actor_death": ("error", "an actor is permanently dead"),
+        "worker_exit": ("info", "a worker exited"),
+    }
+
+    def emit_event(kind, message="", **kw):
+        pass
+
+    def build_event(kind, message="", **kw):
+        return {"kind": kind}
+"""
+
+BAD_EVENT_KINDS = {
+    "ray_tpu/_private/events.py": _EVENTS_REGISTRY,
+    "ray_tpu/_private/ctl.py": """
+        from ray_tpu._private.events import emit_event
+
+        def on_death(self):
+            emit_event("actor_detah", "typo'd: unqueryable forever")
+            self._emit_event(kind="worker_exti")
+    """,
+}
+
+GOOD_EVENT_KINDS = {
+    "ray_tpu/_private/events.py": _EVENTS_REGISTRY,
+    "ray_tpu/_private/ctl.py": """
+        from ray_tpu._private.events import emit_event
+
+        def on_death(self, dynamic_kind):
+            emit_event("actor_death", "declared kind")
+            self._emit_event(kind="worker_exit")
+            emit_event(dynamic_kind)  # non-literal: out of scope
+    """,
+}
+
+
+def test_event_kinds_bad(tmp_path):
+    from tools.rtcheck.passes.event_kinds import EventKindsPass
+
+    res = run_fixture(tmp_path, BAD_EVENT_KINDS, [EventKindsPass()])
+    msgs = "\n".join(messages(res))
+    assert "'actor_detah'" in msgs and "'worker_exti'" in msgs, msgs
+    assert len(res.findings) == 2
+
+
+def test_event_kinds_good(tmp_path):
+    from tools.rtcheck.passes.event_kinds import EventKindsPass
+
+    res = run_fixture(tmp_path, GOOD_EVENT_KINDS, [EventKindsPass()])
+    assert res.ok, messages(res)
+
+
+def test_event_kinds_registry_gone_is_a_finding(tmp_path):
+    """Deleting/renaming the KINDS registry while emission sites exist
+    must fail loudly, not silently skip the whole check."""
+    from tools.rtcheck.passes.event_kinds import EventKindsPass
+
+    files = {
+        "ray_tpu/_private/events.py": """
+            def emit_event(kind, message="", **kw):
+                pass
+        """,
+        "ray_tpu/_private/ctl.py": """
+            from ray_tpu._private.events import emit_event
+
+            def f():
+                emit_event("actor_death")
+        """,
+    }
+    res = run_fixture(tmp_path, files, [EventKindsPass()])
+    assert any("no declared event kinds" in f.message for f in res.findings)

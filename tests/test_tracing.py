@@ -364,7 +364,11 @@ def test_timeline_cli_exports_perfetto_json(monkeypatch, shutdown_only,
     assert ray_tpu.get(traced_fn.remote(21), timeout=60) == 42
     from ray_tpu.util import state
 
-    _wait(lambda: any(r["spans"] for r in state.list_traces()),
+    # Wait for what the export assert below actually needs (>= 3 spans):
+    # the worker's execute/result spans ride a LATER metrics-flush tick
+    # than the driver's submit span, and exporting after the first span
+    # alone made this a load-dependent flake.
+    _wait(lambda: any(r["spans"] >= 3 for r in state.list_traces()),
           30, "traces indexed controller-side")
 
     head = ray_tpu._head

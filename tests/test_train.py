@@ -83,14 +83,17 @@ def mnist_train_loop(config):
 
 
 def test_jax_trainer_mnist_2workers(ray_start_4cpu, tmp_path):
-    # 8 steps, not 4: adam(1e-2) spikes the loss on its first update
-    # (second-moment warmup) and needs a few steps to come back under the
-    # initial value — with 4 the "loss decreased" assertion fails
-    # deterministically on this jax/optax build while training is in fact
-    # converging (2.33 -> 3.30 -> ... -> 2.25 by step 7).
+    # 12 steps, not 8: adam(1e-2) spikes the loss on its first update
+    # (second-moment warmup) and needs steps to come back under the
+    # initial value on BOTH workers' shards — at 8, one worker still sits
+    # at 2.386 vs its 2.311 start on this jax/optax build, so the old
+    # positional "loss decreased" assert passed or failed depending on
+    # which worker's report happened to drain last (a real full-suite
+    # flake). By step 11 both shards are clearly converged
+    # (2.33/2.31 -> 3.30/3.38 -> ... -> 2.19/2.20).
     trainer = JaxTrainer(
         mnist_train_loop,
-        train_loop_config={"batch": 64, "steps": 8},
+        train_loop_config={"batch": 64, "steps": 12},
         scaling_config=ScalingConfig(num_workers=2),
         run_config=RunConfig(name="mnist_e2e", storage_path=str(tmp_path)),
     )
@@ -98,13 +101,23 @@ def test_jax_trainer_mnist_2workers(ray_start_4cpu, tmp_path):
     assert result.error is None, result.error
     assert result.metrics is not None and "loss" in result.metrics
     assert result.checkpoint is not None
-    # loss decreased over training
-    losses = [m["loss"] for m in result.metrics_history if m.get("step") is not None]
-    assert losses[-1] < losses[0]
+    # loss decreased over training — compared BY STEP, not by history
+    # position: metrics_history interleaves both workers' reports in drain
+    # order, so a positional losses[-1] reads whichever worker drained
+    # last (drain order varies under CI load).
+    by_step: dict = {}
+    for m in result.metrics_history:
+        if m.get("step") is not None:
+            by_step.setdefault(m["step"], []).append(m["loss"])
+    first, last = min(by_step), max(by_step)
+    assert last == 11
+    mean = lambda xs: sum(xs) / len(xs)  # noqa: E731 — workers' shard
+    # losses differ; the group-level claim is about their mean per step
+    assert mean(by_step[last]) < mean(by_step[first]), by_step
     # checkpoint is loadable
     with open(os.path.join(result.checkpoint.path, "state.pkl"), "rb") as f:
         state = pickle.load(f)
-    assert state["step"] == 7
+    assert state["step"] == 11
 
 
 def test_jax_trainer_failure_restart(ray_start_4cpu, tmp_path):

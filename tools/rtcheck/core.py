@@ -14,6 +14,9 @@ rtcheck turns the recurring invariant classes into CI-failing passes:
                       classes don't mutate shared attrs half-locked
   exception-taxonomy  no swallowed bare/overbroad excepts in _private/ hot
                       paths; RPC handlers raise only taxonomy exceptions
+  event-kinds         every emit_event kind literal is declared in the
+                      events.py KINDS registry (typo'd kinds are
+                      unqueryable forever)
 
 Framework pieces here: the Finding model, inline `# rtcheck: disable=<pass>`
 suppressions, the checked-in baseline (grandfathered findings), a per-file
@@ -203,15 +206,16 @@ class Project:
 
 # --------------------------------------------------------------------- passes
 def all_passes() -> list[Pass]:
-    from tools.rtcheck.passes import (async_blocking, exception_taxonomy,
-                                      knob_registry, lock_discipline,
-                                      wire_schema)
+    from tools.rtcheck.passes import (async_blocking, event_kinds,
+                                      exception_taxonomy, knob_registry,
+                                      lock_discipline, wire_schema)
 
     return [async_blocking.AsyncBlockingPass(),
             wire_schema.WireSchemaPass(),
             knob_registry.KnobRegistryPass(),
             lock_discipline.LockDisciplinePass(),
-            exception_taxonomy.ExceptionTaxonomyPass()]
+            exception_taxonomy.ExceptionTaxonomyPass(),
+            event_kinds.EventKindsPass()]
 
 
 def _tool_version() -> str:

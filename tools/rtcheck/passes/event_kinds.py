@@ -1,0 +1,133 @@
+"""event-kinds: every emitted event kind is declared in the KINDS registry.
+
+The cluster event plane (README "Cluster events") indexes and queries
+events by their `kind` string. A typo'd kind at an emission site —
+`emit_event("actor_detah", ...)` — is silently accepted at runtime (the
+plane must never throw from a lifecycle path), lands in the ring with a
+kind nothing queries, and is therefore unfindable forever. The registry in
+`ray_tpu/_private/events.py` (the `KINDS` dict literal) is the single
+source of truth; this pass joins every literal-kind emission site in
+ray_tpu/ against it.
+
+Checked call shapes: `emit_event("kind", ...)` / `emit_event(kind="kind")`
+and the controller/agent method spelling `self._emit_event(...)` /
+`events_mod.build_event(...)`. Non-literal kinds (variables) are out of
+scope — the registry check is for the static sites, which is all of them
+today.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Any
+
+from tools.rtcheck.core import FileCtx, Finding, Pass
+
+_ID = "event-kinds"
+
+EVENTS_PATH = "ray_tpu/_private/events.py"
+
+#: Function/method names whose first argument (or kind=) is an event kind.
+_EMIT_NAMES = ("emit_event", "_emit_event", "build_event")
+
+
+class EventKindsPass(Pass):
+    """emit_event kind literals must be declared in events.KINDS."""
+
+    id = _ID
+
+    def wants(self, relpath: str) -> bool:
+        return relpath.startswith("ray_tpu/")
+
+    def check_file(self, ctx: FileCtx) -> tuple[list[Finding], Any]:
+        facts: dict[str, Any] = {}
+        if ctx.path == EVENTS_PATH:
+            kinds = _declared_kinds(ctx.tree)
+            if kinds:
+                facts["kinds"] = kinds
+        uses = _emit_sites(ctx)
+        if uses:
+            facts["uses"] = uses
+        return [], facts or None
+
+    def finalize(self, facts: dict[str, Any], project) -> list[Finding]:
+        findings: list[Finding] = []
+        kinds: dict[str, int] = {}
+        for fact in facts.values():
+            kinds.update(fact.get("kinds", {}))
+        if not kinds:
+            if EVENTS_PATH in project.analyzed:
+                findings.append(Finding(
+                    _ID, EVENTS_PATH, 1,
+                    "no declared event kinds found — the events.py KINDS "
+                    "registry parsing broke or the registry moved"))
+                return findings
+            # Restricted-root run (e.g. `rtcheck ray_tpu/serve`): read the
+            # registry from disk so emission sites still get checked.
+            src = project.read_text(EVENTS_PATH)
+            if src is None:
+                return []  # tree without an events module (pass fixtures)
+            try:
+                kinds = _declared_kinds(ast.parse(src))
+            except SyntaxError:
+                return []
+            if not kinds:
+                return []
+        for path, fact in sorted(facts.items()):
+            for use in fact.get("uses", ()):
+                if use["kind"] not in kinds:
+                    findings.append(Finding(
+                        _ID, path, use["line"],
+                        f"event kind {use['kind']!r} is not declared in the "
+                        f"events.py KINDS registry — an undeclared kind is "
+                        f"unqueryable forever (add it to KINDS, or fix the "
+                        f"typo)"))
+        return findings
+
+
+def _declared_kinds(tree: ast.AST) -> dict[str, int]:
+    """kind -> lineno for every string key of the module-scope
+    `KINDS = {...}` dict literal (AnnAssign spelling included)."""
+    out: dict[str, int] = {}
+    for node in ast.walk(tree):
+        target = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target, value = node.targets[0], node.value
+        elif isinstance(node, ast.AnnAssign):
+            target, value = node.target, node.value
+        else:
+            continue
+        if (isinstance(target, ast.Name) and target.id == "KINDS"
+                and isinstance(value, ast.Dict)):
+            for k in value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    out[k.value] = k.lineno
+    return out
+
+
+def _emit_sites(ctx: FileCtx) -> list[dict]:
+    """Every literal-kind emission call in the file."""
+    out: list[dict] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else None)
+        if name not in _EMIT_NAMES:
+            continue
+        kind = None
+        if node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            kind = node.args[0].value
+        else:
+            for kw in node.keywords:
+                if kw.arg == "kind" and isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, str):
+                    kind = kw.value.value
+        if kind is None:
+            continue  # dynamic kind: out of scope
+        if ctx.suppressed(_ID, node.lineno):
+            continue
+        out.append({"kind": kind, "line": node.lineno})
+    return out
