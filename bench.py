@@ -250,6 +250,11 @@ def main(smoke: bool = False):
         # the noise bound of RT_EVENTS_BUFFER=0 (events are emitted at
         # lifecycle rate, never per task).
         _bench_events_overhead(extra_details)
+        # Compiled dataflow plane (perf-gate input, ISSUE 15): steady-state
+        # us/step for a 3-stage chain through pre-wired shm channels vs the
+        # SAME chain as direct-dispatch .remote() calls — the compiled path
+        # must be >= 3x faster (the owner/controller are out of the loop).
+        _bench_dag_steady_state(extra_details)
         # Serving hot loop (perf-gate input, ISSUE 13): end-to-end SSE
         # streaming decode through proxy+replica+token-ring vs the SAME
         # engine isolated in-process — the ratio is the serving tax. The
@@ -600,6 +605,86 @@ def _bench_events_overhead(details: dict):
                 pass
 
     _ab_overhead_lane("events", run_once, details)
+
+
+def _bench_dag_steady_state(details: dict):
+    """Compiled dataflow plane A/B (smoke only; README "Compiled graphs"):
+    us/step for a 3-stage chain executed through a compiled graph
+    (`execute().get()` per step — pre-negotiated shm channels, zero
+    per-call RPC) vs the SAME chain as direct-dispatch `.remote()` calls.
+    Both legs share ONE cluster (no env flip needed) and interleave
+    through the shared ratio-of-medians estimator; the "overhead" the
+    lane reports is direct/compiled — the inverse of the speedup — so
+    the estimator's extension condition short-circuits. The perf gate
+    (tests/test_perf_smoke.py, RT_RUN_PERF=1) asserts compiled >= 3x."""
+    import ray_tpu
+
+    cdag = None
+    ok = False
+    try:
+        ray_tpu.init(num_cpus=4)
+        from ray_tpu.dag import InputNode
+        from ray_tpu.dag import compile as dag_compile
+
+        @ray_tpu.remote
+        def f(x):
+            return x + 1
+
+        @ray_tpu.remote
+        def g(x):
+            return x * 2
+
+        @ray_tpu.remote
+        def h(x):
+            return x - 3
+
+        with InputNode() as inp:
+            dag = h.bind(g.bind(f.bind(inp)))
+        cdag = dag_compile(dag)
+
+        def compiled_step():
+            assert cdag.execute(4).get(timeout=60) == 7
+
+        def direct_step():
+            assert ray_tpu.get(h.remote(g.remote(f.remote(4))),
+                               timeout=60) == 7
+
+        compiled_step()  # warm both paths (stage loops up, pool workers)
+        direct_step()
+
+        def run_once(compiled_leg: bool) -> float:
+            return timeit(
+                f"dag 3-stage chain "
+                f"({'compiled' if compiled_leg else 'direct dispatch'})",
+                compiled_step if compiled_leg else direct_step,
+                min_time=max(MIN_TIME, 1.0))
+
+        _ab_overhead_lane("dag_steady_state", run_once, details)
+        ok = True
+    except Exception as e:
+        log(f"  dag_steady_state skipped: {e}")
+    finally:
+        # teardown runs on the failure paths too (idempotent): a skipped
+        # lane must not leave the graph's rtch_* shm segments behind.
+        if cdag is not None:
+            try:
+                cdag.teardown()
+            except Exception:
+                pass
+        try:
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+    if not ok:
+        return
+    on = details.get("dag_steady_state_on_tasks_s")    # compiled steps/s
+    off = details.get("dag_steady_state_off_tasks_s")  # direct steps/s
+    if on and off:
+        details["dag_compiled_us_step"] = round(1e6 / on, 1)
+        details["dag_direct_us_step"] = round(1e6 / off, 1)
+        details["dag_steady_state_speedup"] = round(on / off, 2)
+        log(f"  dag_steady_state: compiled {1e6 / on:.0f} us/step vs "
+            f"direct dispatch {1e6 / off:.0f} us/step ({on / off:.1f}x)")
 
 
 # ---- compiled-graph channel round-trip (native futex ring) ---------------

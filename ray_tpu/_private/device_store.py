@@ -255,6 +255,22 @@ def pin_put(oid: str, value, worker) -> tuple[bytes, int]:
     return _ref_blob(_make_desc(oid, value, nbytes, worker)), nbytes
 
 
+def pin_edge(oid: str, value, worker):
+    """Pin a produced array for a PRE-NEGOTIATED point-to-point edge
+    (compiled-DAG device edges, README "Compiled graphs"): like pin_return
+    but OUTSIDE the owner-refcount plane — no controller registration, no
+    free fan-out. The producing stage owns the pin's lifetime and drops it
+    via free_local once every consumer's channel read has provably
+    advanced past the invocation (the edge protocol's retention window).
+    Returns the placeholder object whose pickle is the ~200B wire payload
+    and whose unpickle resolves through the ordinary tier ladder."""
+    nbytes = int(value.nbytes)
+    _TABLE.pin(oid, value, nbytes)
+    _ensure_metrics_flusher()
+    _notify_pins()
+    return _DeviceRef(_make_desc(oid, value, nbytes, worker))
+
+
 def advert_fields(worker_id: str, node_id: str) -> dict:
     """Extra register_put fields marking a directory entry device-resident
     (consumed by the controller for list_objects' plane column, free

@@ -112,6 +112,14 @@ KINDS: dict[str, tuple[str, str]] = {
     "serve_scale": ("info", "a serve deployment's replica target changed"),
     "serve_replica_death": ("warning", "a serve replica failed its health "
                                        "check or failed to start"),
+    # --- compiled dataflow graphs (driver-emitted) -------------------------
+    "dag_compiled": ("info", "a DAG was compiled into persistent stage "
+                             "loops wired by pre-negotiated shm channels"),
+    "dag_stage_death": ("error", "a compiled-DAG stage died mid-run "
+                                 "(attrs.stage names it); every in-flight "
+                                 "invocation failed with DagStageError"),
+    "dag_teardown": ("info", "a compiled DAG tore down; all stage loops "
+                             "stopped and every channel was unlinked"),
     # --- jobs (controller-emitted) -----------------------------------------
     "job_start": ("info", "a job driver subprocess was launched"),
     "job_stop": ("info", "a job reached a terminal state"),

@@ -262,6 +262,26 @@ _flag("token_ring_bytes", int, 1 << 20)
 # running batch at chunk boundaries, so a new request's prefill compile/
 # dispatch never stalls the decode loop. False restores inline admission.
 _flag("llm_prefill_lane", bool, True)
+# --- compiled dataflow graphs (README "Compiled graphs") --------------------
+# Max invocations a compiled DAG keeps in flight: execute() returns a
+# DagRef immediately and only blocks once this many invocations are still
+# unfulfilled (per-invocation sequence numbers ride every edge, so stages
+# stay in lockstep without a barrier).
+_flag("dag_max_inflight", int, 8)
+# Device-object edges: a stage output that is a large single-device
+# jax.Array stays pinned in the producing stage's DeviceObjectTable and
+# the channel carries only the ~200B placeholder — co-located consumers
+# resolve it zero-copy (same process) or one-copy (same-host shm export)
+# through the PR 7 tier ladder. False pickles every value through the shm
+# ring, byte-identically to the host path.
+_flag("dag_device_edges", bool, True)
+# Compiled-driver stage-liveness monitor cadence: stage actor/worker death
+# surfaces as a typed DagStageError on every in-flight DagRef within a few
+# of these polls (plus the runtime's own death-detection latency).
+_flag("dag_monitor_interval_s", float, 0.2)
+# Per-edge shm channel capacity (one in-flight message per edge; a
+# message may be at most this large).
+_flag("dag_channel_bytes", int, 1 << 20)
 # --- kernels / diagnostics --------------------------------------------------
 # Decode-attention kernel selection: "pallas" / "xla" force a path, ""
 # keeps the size-based dispatch (ops/decode_attention.py

@@ -269,6 +269,36 @@ def task_execute_end(handle: Optional[list], ok: bool = True) -> None:
                 time.time(), {"task": task_id, "attempt": attempt, "ok": ok})
 
 
+def open_root(name: str, kind: str = "op"):
+    """Open a root-or-child span WITHOUT installing the contextvar, for
+    operations fulfilled on a DIFFERENT thread than the one that opened
+    them (the compiled-DAG driver opens `dag.execute` at submit time; its
+    collector thread closes it at fulfillment). Returns an opaque handle —
+    None when tracing is off or an unsampled root — whose first two slots
+    are the wire TraceContext children parent to."""
+    if not enabled():
+        return None
+    ctx = _ctx.get()
+    if ctx is None:
+        if not _sampled():
+            return None
+        trace_id, parent = _new_id(16), None
+    else:
+        trace_id, parent = ctx
+    return [trace_id, _new_id(8), parent, name, kind, time.time()]
+
+
+def close_root(handle, attrs: Optional[dict] = None) -> Optional[str]:
+    """Close an open_root handle, recording the span with its real
+    duration. Safe from any thread; returns the trace id (None no-op)."""
+    if handle is None:
+        return None
+    trace_id, span_id, parent, name, kind, start = handle
+    record_span(trace_id, span_id, parent, name, kind, start, time.time(),
+                attrs)
+    return trace_id
+
+
 @contextmanager
 def span(name: str, kind: str = "op", attrs: Optional[dict] = None):
     """Span a code block under the current context; no-op when tracing is

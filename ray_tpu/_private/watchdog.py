@@ -154,6 +154,22 @@ def report_progress(message: str | None = None) -> None:
         record("progress", message)
 
 
+def progress_slice_s(default: float = 0.25) -> float:
+    """Wait-slice length for loops that block on EXTERNAL progress
+    (compiled-DAG channel reads, armed collective recvs): while the stall
+    plane is armed, indefinite waits must be chopped into slices shorter
+    than the beacon interval with a `report_progress()` tick per slice, so
+    an idle wait is never mistaken for a stalled task. Unarmed, callers
+    keep their own (longer) default — the tick is a no-op anyway."""
+    if not _armed:
+        return default
+    try:
+        return max(0.05, min(default,
+                             float(CONFIG.stall_beacon_interval_s) / 2.0))
+    except Exception:
+        return default
+
+
 def executing_snapshot() -> list[dict]:
     """Copies of every executing-task state (monitor + beacon source)."""
     with _exec_lock:

@@ -135,6 +135,11 @@ class WorkerProc:
         self._event_win_start = 0.0
         self._event_win_count = 0
         self._advertise_pusher: _BatchPusher | None = None
+        # Compiled-DAG loop threads attached to this actor: dag tag ->
+        # list of stop events (one per loop; a dag may bind several of
+        # this actor's methods). `__rt_dag_cancel__` sets them so a loop
+        # parked on a dead upstream's channel exits promptly at teardown.
+        self._dag_stops: dict[str, list] = {}
         self._pins_flagged = False  # last device_pins state told to the agent
         self._pins_lock = threading.Lock()  # orders flag updates vs pushes
         self._pid = os.getpid()  # cached: one event record per task must
@@ -532,6 +537,20 @@ class WorkerProc:
             # flowing; the reply resolves when the DAG tears down.
             self._start_dag_loop(spec, reply_slot)
             return
+        if spec.method_name == "__rt_dag_cancel__":
+            # Compiled-DAG teardown: cancel this actor's loop threads for
+            # the named dag (their upstream may be dead, so the graceful
+            # stop token may never arrive through the channels).
+            error_blob = None
+            try:
+                (desc,), _ = self.worker.decode_args(spec.args, spec.kwargs)
+                for ev in list(self._dag_stops.get(desc.get("tag"), ())):
+                    ev.set()
+            except BaseException as e:  # noqa: BLE001 - reply must go out
+                error_blob = self._make_error_blob(spec, e)
+            self._reply_value(reply_slot, spec.task_id,
+                              self._finish_actor_task(spec, None, error_blob))
+            return
         ent = self._method_cache.get(spec.method_name)
         if ent is None and self.actor_instance is not None:
             m = getattr(self.actor_instance, spec.method_name, None)
@@ -574,16 +593,32 @@ class WorkerProc:
         def _run():
             error_blob = None
             value = None
+            stop = threading.Event()
+            tag = None
             try:
                 from ray_tpu.dag import run_stage_loop
 
                 (desc,), _ = self.worker.decode_args(spec.args, spec.kwargs)
+                tag = desc.get("tag")
+                if tag:
+                    self._dag_stops.setdefault(tag, []).append(stop)
                 method = getattr(self.actor_instance, desc["method"])
                 value = run_stage_loop(
                     method, desc["in_specs"], desc["out_names"],
-                    desc.get("kwargs") or {}, desc["size"])
+                    desc.get("kwargs") or {}, desc["size"],
+                    stage=desc.get("stage", "stage"), stop=stop)
             except BaseException as e:  # noqa: BLE001
                 error_blob = self._make_error_blob(spec, e)
+            finally:
+                if tag:
+                    evs = self._dag_stops.get(tag)
+                    if evs is not None:
+                        try:
+                            evs.remove(stop)
+                        except ValueError:
+                            pass
+                        if not evs:
+                            self._dag_stops.pop(tag, None)
             reply = self._finish_actor_task(spec, value, error_blob)
             self._reply_value(reply_slot, spec.task_id, reply)
 

@@ -90,6 +90,37 @@ class CollectiveTimeoutError(RayTpuError, TimeoutError):
     waiting on."""
 
 
+def _rebuild_dag_stage_error(message, stage, node, invocation, traceback_str):
+    return DagStageError(message, stage=stage, node=node,
+                         invocation=invocation, traceback_str=traceback_str)
+
+
+class DagStageError(RayTpuError):
+    """A compiled-DAG stage failed or died (README "Compiled graphs").
+
+    Raised on `DagRef.get()` for the invocation(s) the failure covers:
+    either the stage's user code raised (the remote traceback is carried in
+    `traceback_str`), or the stage process/actor died mid-steady-state (the
+    compiled driver's liveness monitor attributes the death). `stage` names
+    the failed stage, `node` the node it ran on when known, `invocation`
+    the in-flight sequence number the error was delivered for.
+    """
+
+    def __init__(self, message: str, *, stage: str | None = None,
+                 node: str | None = None, invocation: int | None = None,
+                 traceback_str: str | None = None):
+        self.stage = stage
+        self.node = node
+        self.invocation = invocation
+        self.traceback_str = traceback_str
+        super().__init__(message)
+
+    def __reduce__(self):
+        return (_rebuild_dag_stage_error,
+                (str(self), self.stage, self.node, self.invocation,
+                 self.traceback_str))
+
+
 class RuntimeEnvSetupError(RayTpuError):
     """Setting up the runtime environment for a task/actor failed."""
 

@@ -45,11 +45,24 @@ class Channel:
         self.size = size
         self._path = os.path.join("/dev/shm", f"rtch_{name}")
         total = _DATA + size
-        exists = os.path.exists(self._path)
-        fd = os.open(self._path, os.O_CREAT | os.O_RDWR, 0o600)
+        if _create:
+            exists = os.path.exists(self._path)
+            fd = os.open(self._path, os.O_CREAT | os.O_RDWR, 0o600)
+            try:
+                if not exists or os.fstat(fd).st_size != total:
+                    # Fresh segment, or a stale same-named file from a
+                    # crashed run whose size disagrees: (re)size it. The
+                    # creator owns the layout.
+                    os.ftruncate(fd, total)
+            except Exception:
+                os.close(fd)
+                raise
+        else:
+            # Attach STRICTLY: no O_CREAT. An attacher racing a teardown
+            # unlink must fail loudly instead of silently re-creating an
+            # orphan segment nobody will ever unlink again.
+            fd = os.open(self._path, os.O_RDWR)
         try:
-            if not exists:
-                os.ftruncate(fd, total)
             self._mm = mmap.mmap(fd, total)
         finally:
             os.close(fd)

@@ -1,0 +1,190 @@
+"""Chaos: compiled dataflow graphs under stage death (README "Compiled
+graphs" failure model).
+
+Pins the acceptance behaviors of ISSUE 15: SIGKILLing ANY stage during
+pipelined steady state surfaces a typed DagStageError NAMING the stage on
+every in-flight DagRef within the detection deadline (never a hang), the
+`dag_stage_death` event lands entity-linked in the PR 14 event plane, and
+teardown after chaos leaves ZERO leaked shm channels (kill-then-unlink,
+unconditionally)."""
+
+import os
+import signal
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import DagStageError
+
+DEADLINE_S = 25.0  # detection budget: runtime death detection + one poll
+
+
+def _wait(pred, timeout=30.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        out = pred()
+        if out:
+            return out
+        time.sleep(0.2)
+    raise TimeoutError(f"timed out waiting for {what}")
+
+
+def test_function_stage_sigkill_attributes_all_inflight(ray_start_4cpu):
+    """Kill the MIDDLE function-stage actor of a 3-stage chain with
+    several invocations in flight: every in-flight DagRef fails with
+    DagStageError naming the stage and its invocation, later executes
+    fail fast, the event chain lands, and teardown leaks nothing."""
+    from ray_tpu.dag import InputNode, compile
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    def head(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def mid(x):
+        time.sleep(0.2)  # hold a queue so invocations pile up in flight
+        return x * 10
+
+    @ray_tpu.remote
+    def tail(x):
+        return x - 1
+
+    with InputNode() as inp:
+        dag = tail.bind(mid.bind(head.bind(inp)))
+    cdag = compile(dag)
+    paths = [ch._path for ch in cdag._channels]
+    try:
+        # Healthy steady state first.
+        assert cdag.execute(1).get(timeout=60) == (1 + 1) * 10 - 1
+        mid_pid = ray_tpu.get(cdag._actors[1].pid.remote(), timeout=30)
+
+        refs = [cdag.execute(i) for i in range(2, 8)]  # pipelined in flight
+        t0 = time.monotonic()
+        os.kill(mid_pid, signal.SIGKILL)
+
+        for r in refs:
+            with pytest.raises(DagStageError) as ei:
+                r.get(timeout=DEADLINE_S + 10)
+            e = ei.value
+            assert e.stage and "mid" in e.stage, f"error does not name stage: {e}"
+            assert e.invocation == r.seq
+            assert "died" in str(e)
+        detect_s = time.monotonic() - t0
+        assert detect_s < DEADLINE_S, (
+            f"attribution took {detect_s:.1f}s (> {DEADLINE_S}s deadline)")
+
+        # The failure is sticky: a NEW execute fails fast and typed.
+        with pytest.raises(DagStageError, match="mid"):
+            cdag.execute(99)
+
+        # Event chain: dag_stage_death entity-linked to the dag id.
+        def _death_event():
+            rows = state.list_events(entity=cdag.dag_id)
+            return [e for e in rows if e["kind"] == "dag_stage_death"] or None
+
+        evs = _wait(_death_event, what="dag_stage_death event")
+        assert "mid" in evs[0]["attrs"]["stage"]
+        assert evs[0]["sev"] == "error"
+    finally:
+        cdag.teardown()
+    leaked = [p for p in paths if os.path.exists(p)]
+    assert not leaked, f"chaos teardown leaked shm channels: {leaked}"
+    # The events plane also saw the (forced) teardown.
+    _wait(lambda: [e for e in state.list_events(entity=cdag.dag_id)
+                   if e["kind"] == "dag_teardown"] or None,
+          what="dag_teardown event")
+
+
+def test_actor_method_stage_sigkill_and_loop_cancel(ray_start_4cpu):
+    """Kill an EXISTING actor hosting a bound-method stage: in-flight
+    refs attribute to that stage, and teardown cooperatively cancels the
+    SURVIVING downstream actor's loop thread (its stop token can never
+    arrive through the dead upstream) — the survivor keeps serving normal
+    calls and no channel leaks."""
+    from ray_tpu.dag import InputNode, compile
+
+    @ray_tpu.remote
+    class Upstream:
+        def work(self, x):
+            time.sleep(0.15)
+            return x * 2
+
+        def pid(self):
+            return os.getpid()
+
+    @ray_tpu.remote
+    class Downstream:
+        def __init__(self):
+            self.seen = 0
+
+        def post(self, x):
+            self.seen += 1
+            return x + 1
+
+        def count(self):
+            return self.seen
+
+    up, down = Upstream.remote(), Downstream.remote()
+    up_pid = ray_tpu.get(up.pid.remote(), timeout=60)
+    with InputNode() as inp:
+        dag = down.post.bind(up.work.bind(inp))
+    cdag = compile(dag)
+    paths = [ch._path for ch in cdag._channels]
+    try:
+        assert cdag.execute(3).get(timeout=60) == 7
+        refs = [cdag.execute(i) for i in range(4)]
+        t0 = time.monotonic()
+        os.kill(up_pid, signal.SIGKILL)
+        for r in refs:
+            with pytest.raises(DagStageError) as ei:
+                r.get(timeout=DEADLINE_S + 10)
+            assert ei.value.stage and "work" in ei.value.stage
+        assert time.monotonic() - t0 < DEADLINE_S
+    finally:
+        cdag.teardown()
+    leaked = [p for p in paths if os.path.exists(p)]
+    assert not leaked, f"chaos teardown leaked shm channels: {leaked}"
+    # The surviving actor's loop thread was cancelled (not wedged on the
+    # dead edge): it still answers normal calls promptly.
+    assert ray_tpu.get(down.count.remote(), timeout=30) >= 1
+
+
+def test_dead_dag_refs_never_hang_without_get(ray_start_2cpu):
+    """A consumer that parked on DagRef.get BEFORE the death still gets
+    the attributed error (the monitor fulfills refs; nothing depends on
+    the caller polling)."""
+    import threading
+
+    from ray_tpu.dag import InputNode, compile
+
+    @ray_tpu.remote
+    def slow(x):
+        time.sleep(0.3)
+        return x
+
+    with InputNode() as inp:
+        dag = slow.bind(inp)
+    cdag = compile(dag)
+    try:
+        pid = ray_tpu.get(cdag._actors[0].pid.remote(), timeout=30)
+        ref = cdag.execute(1)
+        got: list = []
+
+        def consume():
+            try:
+                got.append(("ok", ref.get(timeout=DEADLINE_S + 10)))
+            except BaseException as e:  # noqa: BLE001 - recorded for assert
+                got.append(("err", e))
+
+        t = threading.Thread(target=consume)
+        t.start()
+        time.sleep(0.1)  # the consumer is parked in get()
+        os.kill(pid, signal.SIGKILL)
+        t.join(timeout=DEADLINE_S + 15)
+        assert not t.is_alive(), "get() hung past the detection deadline"
+        kind, payload = got[0]
+        assert kind == "err" and isinstance(payload, DagStageError), payload
+    finally:
+        cdag.teardown()

@@ -1,0 +1,398 @@
+"""Compiled dataflow execution plane (README "Compiled graphs").
+
+Pins the four tentpole behaviors of the plane: pipelined execution
+(execute() returns a DagRef; multiple invocations in flight, fulfilled in
+order), general graph shapes (fan-in/fan-out/multi-output/actor-method),
+typed attributed stage failure (DagStageError naming the stage with the
+full remote traceback, per-invocation — the pipeline survives), and
+device-object edges (large jax.Array stage outputs ride the PR 7 device
+plane as ~200B placeholders, byte-identical to the host path when off).
+
+reference tests: python/ray/dag/tests/experimental/test_accelerated_dag.py
++ test_torch_tensor_dag.py (the device-edge analog).
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import DagStageError, RayTpuError
+
+
+def _wait(pred, timeout=30.0, what="condition"):
+    deadline = time.monotonic() + timeout
+    last = None
+    while time.monotonic() < deadline:
+        try:
+            last = pred()
+            if last:
+                return last
+        except Exception:
+            pass
+        time.sleep(0.2)
+    raise TimeoutError(f"timed out waiting for {what}")
+
+
+# ----------------------------------------------------------- pipelining
+def test_pipelined_execute_returns_dagrefs_in_flight(ray_start_4cpu):
+    """execute() must NOT block for the result: with a slow middle stage,
+    many invocations are submitted while earlier ones are still in the
+    pipe, and results fulfill in submission order."""
+    from ray_tpu.dag import InputNode, compile
+
+    @ray_tpu.remote
+    def slow(x):
+        time.sleep(0.15)
+        return x * 10
+
+    @ray_tpu.remote
+    def fast(x):
+        return x + 1
+
+    with InputNode() as inp:
+        dag = fast.bind(slow.bind(inp))
+    cdag = compile(dag)
+    try:
+        t0 = time.perf_counter()
+        refs = [cdag.execute(i, timeout=60) for i in range(6)]
+        submit_s = time.perf_counter() - t0
+        # At 0.15s/invocation a synchronous execute would take >= 0.9s to
+        # submit 6; pipelined submission must be far faster AND leave work
+        # genuinely in flight.
+        assert submit_s < 0.6, f"submission took {submit_s:.2f}s (not pipelined)"
+        assert not refs[-1].done(), "last invocation done at submit time?"
+        assert [r.get(timeout=60) for r in refs] == [
+            i * 10 + 1 for i in range(6)]
+        assert all(r.done() for r in refs)
+    finally:
+        cdag.teardown()
+
+
+def test_max_inflight_bounds_submission(ray_start_2cpu, monkeypatch):
+    """RT_DAG_MAX_INFLIGHT bounds unfulfilled invocations: with the bound
+    at 2 and a stage holding results back, the third execute() parks and
+    times out; draining the pipe unblocks submission."""
+    monkeypatch.setenv("RT_DAG_MAX_INFLIGHT", "2")
+    from ray_tpu.dag import InputNode, compile
+    from ray_tpu.exceptions import GetTimeoutError
+
+    @ray_tpu.remote
+    def slow(x):
+        time.sleep(0.4)
+        return x
+
+    with InputNode() as inp:
+        dag = slow.bind(inp)
+    cdag = compile(dag)
+    try:
+        r0 = cdag.execute(0)
+        r1 = cdag.execute(1)
+        with pytest.raises(GetTimeoutError, match="in flight"):
+            cdag.execute(2, timeout=0.05)
+        assert r0.get(timeout=30) == 0 and r1.get(timeout=30) == 1
+        # Fulfilled results release the window.
+        assert cdag.execute(3).get(timeout=30) == 3
+    finally:
+        cdag.teardown()
+
+
+# ------------------------------------------------------------- graph shapes
+def test_fan_in_fan_out_multi_output_actor_method(ray_start_4cpu):
+    """One graph exercising every shape at once: an EXISTING actor's
+    method stage fans out to a function join (fan-in) and a second output
+    (multi-output), with a literal kwarg riding a stage."""
+    from ray_tpu.dag import InputNode, MultiOutputNode, compile
+
+    @ray_tpu.remote
+    class Scaler:
+        def __init__(self, k):
+            self.k = k
+            self.calls = 0
+
+        def scale(self, x):
+            self.calls += 1
+            return x * self.k
+
+        def count(self):
+            return self.calls
+
+    @ray_tpu.remote
+    def inc(x, by=1):
+        return x + by
+
+    @ray_tpu.remote
+    def join(a, b):
+        return (a, b)
+
+    actor = Scaler.remote(10)
+    with InputNode() as inp:
+        s = actor.scale.bind(inp)           # actor-method stage, fanned out
+        i = inc.bind(inp, by=5)             # literal kwarg
+        dag = MultiOutputNode([join.bind(s, i), inc.bind(s)])
+    cdag = compile(dag)
+    try:
+        for x in (1, 3, 7):
+            j, k = cdag.execute(x).get(timeout=60)
+            assert j == (10 * x, x + 5)
+            assert k == 10 * x + 1
+        # The actor advanced real state and still serves normal calls.
+        assert ray_tpu.get(actor.count.remote(), timeout=30) == 3
+    finally:
+        cdag.teardown()
+    # The user actor survives teardown (only its loop thread stopped).
+    assert ray_tpu.get(actor.count.remote(), timeout=30) == 3
+
+
+# ------------------------------------------------------- attributed errors
+def test_diamond_error_names_stage_and_carries_traceback(ray_start_4cpu):
+    """A stage exception propagates through a diamond to the output as a
+    TYPED DagStageError naming the failing stage with the full remote
+    traceback — and only poisons ITS invocation; the pipeline keeps
+    flowing for the next one."""
+    from ray_tpu.dag import InputNode, compile
+
+    @ray_tpu.remote
+    def src(x):
+        return x
+
+    @ray_tpu.remote
+    def left(x):
+        if x == 13:
+            raise ValueError("kaput-13")
+        return x * 2
+
+    @ray_tpu.remote
+    def right(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def merge(a, b):
+        return a + b
+
+    with InputNode() as inp:
+        s = src.bind(inp)
+        dag = merge.bind(left.bind(s), right.bind(s))
+    cdag = compile(dag)
+    try:
+        assert cdag.execute(2).get(timeout=60) == 2 * 2 + 3
+        with pytest.raises(DagStageError) as ei:
+            cdag.execute(13).get(timeout=60)
+        e = ei.value
+        assert isinstance(e, RayTpuError)          # taxonomy-compliant
+        assert e.stage and "left" in e.stage       # names the stage
+        assert e.invocation == 1                   # names the invocation
+        # Satellite pin: the FULL formatted remote traceback rides along,
+        # not just repr(e).
+        assert e.traceback_str and "Traceback" in e.traceback_str
+        assert 'raise ValueError("kaput-13")' in e.traceback_str
+        assert "kaput-13" in str(e)
+        # Per-invocation failure: the graph is still healthy.
+        assert cdag.execute(4).get(timeout=60) == 4 * 2 + 5
+    finally:
+        cdag.teardown()
+
+
+# ----------------------------------------------------------- teardown/leaks
+def test_teardown_unlinks_every_channel(ray_start_2cpu):
+    """Kill-then-unlink: teardown leaves NO rtch_* shm segment behind,
+    including after a stage error mid-run (the loops that saw the error
+    keep consuming — nothing wedges the stop tokens)."""
+    from ray_tpu.dag import InputNode, compile
+
+    @ray_tpu.remote
+    def maybe_boom(x):
+        if x < 0:
+            raise RuntimeError("negative")
+        return x
+
+    with InputNode() as inp:
+        dag = maybe_boom.bind(inp)
+    cdag = compile(dag)
+    paths = [ch._path for ch in cdag._channels]
+    assert paths and all(os.path.exists(p) for p in paths)
+    with pytest.raises(DagStageError):
+        cdag.execute(-1).get(timeout=60)
+    assert cdag.execute(5).get(timeout=60) == 5
+    cdag.teardown()
+    leaked = [p for p in paths if os.path.exists(p)]
+    assert not leaked, f"teardown leaked shm channels: {leaked}"
+    # Idempotent.
+    cdag.teardown()
+    with pytest.raises(RuntimeError, match="torn down"):
+        cdag.execute(1)
+
+
+def test_oversized_input_fails_attributed_not_hang(ray_start_2cpu):
+    """A value bigger than the edge capacity must fail the invocation with
+    a typed error, not silently strand the already-returned DagRef."""
+    from ray_tpu.dag import InputNode, compile
+
+    @ray_tpu.remote
+    def f(x):
+        return len(x)
+
+    with InputNode() as inp:
+        dag = f.bind(inp)
+    cdag = compile(dag, channel_size=4096)
+    try:
+        ref = cdag.execute(b"x" * 65536)
+        with pytest.raises(DagStageError, match="submission failed"):
+            ref.get(timeout=30)
+    finally:
+        cdag.teardown()
+
+
+# -------------------------------------------------------- device-object edges
+def _device_edge_graph():
+    import jax.numpy as jnp
+
+    n = 1 << 16  # 256KB float32: past RT_DEVICE_OBJECT_MIN_BYTES
+
+    @ray_tpu.remote
+    def produce(x):
+        return jnp.full((n,), float(x), jnp.float32)
+
+    @ray_tpu.remote
+    def transform(a):
+        return a * 2.0 + 1.0
+
+    from ray_tpu.dag import InputNode, compile
+
+    with InputNode() as inp:
+        dag = transform.bind(produce.bind(inp))
+    return compile(dag)
+
+
+def _run_device_edge_dag(cdag, xs):
+    outs = []
+    for x in xs:
+        arr = cdag.execute(x).get(timeout=120)
+        outs.append(np.asarray(arr))
+    return outs
+
+
+def test_device_edges_on_off_byte_equivalence(shutdown_only, device_plane_cpu,
+                                              monkeypatch):
+    """The SAME graph over large jax.Array edges produces byte-identical
+    results with device edges on (placeholders + tier-ladder resolve) and
+    off (RT_DAG_DEVICE_EDGES=0: full pickles through the shm ring) — and
+    the on path actually pins (the channel carried the ~200B ref)."""
+    xs = [1, 2, 3, 4]
+    ray_tpu.init(num_cpus=4)
+    cdag = _device_edge_graph()
+    try:
+        on_outs = _run_device_edge_dag(cdag, xs)
+        # The producing stage holds pins (2-invocation retention window).
+        pins = sum(ray_tpu.get(a.probe.remote(), timeout=30)["count"]
+                   for a in cdag._actors)
+        assert pins > 0, "device edges on but no stage pinned anything"
+    finally:
+        cdag.teardown()
+    ray_tpu.shutdown()
+
+    monkeypatch.setenv("RT_DAG_DEVICE_EDGES", "0")
+    ray_tpu.init(num_cpus=4)
+    cdag = _device_edge_graph()
+    try:
+        off_outs = _run_device_edge_dag(cdag, xs)
+        pins = sum(ray_tpu.get(a.probe.remote(), timeout=30)["count"]
+                   for a in cdag._actors)
+        assert pins == 0, "RT_DAG_DEVICE_EDGES=0 but a stage pinned"
+    finally:
+        cdag.teardown()
+    for on, off in zip(on_outs, off_outs):
+        assert on.dtype == off.dtype and on.shape == off.shape
+        assert np.array_equal(on, off)
+
+
+def test_device_edge_pins_retire_no_leak(ray_start_2cpu, device_plane_cpu):
+    """Steady-state churn must NOT accrete one pinned array per
+    invocation: the 2-invocation retention window bounds producer-side
+    residency."""
+    cdag = _device_edge_graph()
+    try:
+        for x in range(12):
+            cdag.execute(x).get(timeout=120)
+        stats = [ray_tpu.get(a.probe.remote(), timeout=30)
+                 for a in cdag._actors]
+        worst = max(s["count"] for s in stats)
+        assert worst <= 2, f"pins accreted past the retention window: {stats}"
+    finally:
+        cdag.teardown()
+
+
+# ------------------------------------------------------------ observability
+def test_dag_events_compiled_and_teardown(ray_start_2cpu):
+    """dag_compiled / dag_teardown land in the PR 14 event plane, entity-
+    indexed by the dag id."""
+    from ray_tpu.dag import InputNode, compile
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    def f(x):
+        return x
+
+    with InputNode() as inp:
+        dag = f.bind(inp)
+    cdag = compile(dag)
+    dag_id = cdag.dag_id
+    assert cdag.execute(1).get(timeout=60) == 1
+    cdag.teardown()
+
+    def _events():
+        rows = state.list_events(entity=dag_id)
+        kinds = {e["kind"] for e in rows}
+        if {"dag_compiled", "dag_teardown"} <= kinds:
+            return rows
+        return None
+
+    rows = _wait(_events, what="dag lifecycle events")
+    comp = next(e for e in rows if e["kind"] == "dag_compiled")
+    assert comp["attrs"]["stages"] == 1
+    td = next(e for e in rows if e["kind"] == "dag_teardown")
+    assert td["attrs"]["clean"] is True
+
+
+def test_dag_invocation_spans_when_sampled(shutdown_only, monkeypatch):
+    """A sampled invocation records a dag.execute root with per-stage
+    dag.stage children under the PR 11 tracing plane."""
+    monkeypatch.setenv("RT_TRACING", "1")
+    ray_tpu.init(num_cpus=4)
+    from ray_tpu.dag import InputNode, compile
+    from ray_tpu.util import state
+
+    @ray_tpu.remote
+    def a(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def b(x):
+        return x * 2
+
+    with InputNode() as inp:
+        dag = b.bind(a.bind(inp))
+    cdag = compile(dag)
+    try:
+        assert cdag.execute(3).get(timeout=60) == 8
+    finally:
+        cdag.teardown()
+
+    def _spans():
+        for row in state.list_traces(limit=1000):
+            doc = state.get_trace(row["trace_id"])
+            spans = doc.get("spans", [])
+            names = [s.get("n") for s in spans]
+            if "dag.execute" not in names:
+                continue
+            stages = [s for s in spans if s.get("n") == "dag.stage"]
+            if len(stages) >= 2:
+                root = next(s for s in spans if s.get("n") == "dag.execute")
+                # Stage spans parent to the execute span (causal chain).
+                if all(s.get("p") == root.get("s") for s in stages):
+                    return spans
+        return None
+
+    _wait(_spans, what="dag.execute -> dag.stage span chain")

@@ -112,6 +112,22 @@ def test_bench_smoke_runs():
         f"always-on event plane costs {rep['details']['events_overhead']}x "
         f"(off {e_off}/s vs on {e_on}/s medians) — budget is 1.05x "
         f"(noise-widened gate: {e_bound}x)")
+    # Compiled dataflow plane (ISSUE 15 acceptance): steady-state
+    # execution of a 3-stage chain through pre-wired shm channels must
+    # beat the SAME chain as direct-dispatch .remote() calls by >= 3x
+    # us/step (ratio of interleaved-pair medians; README "Compiled
+    # graphs") — taking the owner/controller out of the steady-state
+    # loop is the plane's reason to exist.
+    d_on = rep["details"].get("dag_steady_state_on_tasks_s")
+    d_off = rep["details"].get("dag_steady_state_off_tasks_s")
+    assert d_on and d_off, (
+        "dag_steady_state lane missing (bench skipped it: see its stderr)")
+    d_speedup = rep["details"]["dag_steady_state_speedup"]
+    assert d_speedup >= 3.0, (
+        f"compiled DAG is only {d_speedup}x direct dispatch "
+        f"({rep['details']['dag_compiled_us_step']} vs "
+        f"{rep['details']['dag_direct_us_step']} us/step medians) — "
+        f"the zero-RPC steady state is not earning its keep")
     # Serving hot loop (ISSUE 13 acceptance): end-to-end SSE streaming
     # decode under 4 concurrent clients must hold >= 0.5x of the SAME
     # engine's isolated rate (vs ~0.045x on the per-token reply path the

@@ -125,10 +125,12 @@ def test_compiled_dag_pipeline(ray_start_4cpu):
         dag = shift.bind(scale.bind(inp))
     cdag = compile(dag)
     try:
-        assert cdag.execute(4) == 41
-        # steady-state: repeated executes reuse the same channels/actors
-        outs = [cdag.execute(i) for i in range(20)]
-        assert outs == [i * 10 + 1 for i in range(20)]
+        assert cdag.execute(4).get(timeout=60) == 41
+        # steady-state: repeated executes reuse the same channels/actors,
+        # and multiple invocations stay in flight (pipelined DagRefs).
+        refs = [cdag.execute(i) for i in range(20)]
+        assert [r.get(timeout=60) for r in refs] == [
+            i * 10 + 1 for i in range(20)]
     finally:
         cdag.teardown()
 
@@ -217,7 +219,7 @@ def test_compiled_dag_fan_in_fan_out(ray_start_4cpu):
     cdag = compile(dag)
     try:
         for x in (1, 5, 10):
-            j, k = cdag.execute(x)
+            j, k = cdag.execute(x).get(timeout=60)
             assert j == 2 * x + (x + 1), (x, j)
             assert k == 2 * x + 1, (x, k)
     finally:
@@ -251,9 +253,9 @@ def test_compiled_dag_actor_methods(ray_start_4cpu):
         dag = plus1.bind(actor.scale.bind(inp))
     cdag = compile(dag)
     try:
-        assert cdag.execute(1) == 11
-        assert cdag.execute(2) == 21
-        assert cdag.execute(3) == 31
+        assert cdag.execute(1).get(timeout=60) == 11
+        assert cdag.execute(2).get(timeout=60) == 21
+        assert cdag.execute(3).get(timeout=60) == 31
         # The actor's own state advanced AND it still answers normal calls
         # concurrently with the compiled loop.
         assert ray_tpu.get(actor.count.remote(), timeout=30) == 3
@@ -278,11 +280,13 @@ def test_compiled_dag_stage_error_propagates(ray_start_2cpu):
         dag = after.bind(boom.bind(inp))
     cdag = compile(dag)
     try:
-        with pytest.raises(RuntimeError, match="kaput"):
-            cdag.execute(1)
+        from ray_tpu.exceptions import DagStageError
+
+        with pytest.raises(DagStageError, match="kaput"):
+            cdag.execute(1).get(timeout=60)
         # pipeline stays usable for the next execute
-        with pytest.raises(RuntimeError, match="kaput"):
-            cdag.execute(2)
+        with pytest.raises(DagStageError, match="kaput"):
+            cdag.execute(2).get(timeout=60)
     finally:
         cdag.teardown()
 
