@@ -261,6 +261,12 @@ def main(smoke: bool = False):
         # BENCH_r05 per-token reply path measured ~0.045x; the token-ring
         # path must hold >= 0.5x under 4 concurrent streaming clients.
         _bench_serve_decode_e2e(extra_details)
+        # Overload & admission control (perf-gate input, ISSUE 17):
+        # admission-off A/B on the handle path (the plane must be free
+        # when budgets aren't binding) + a ~10x SSE overload storm against
+        # a capped LLM deployment — every client resolves, queue-full
+        # sheds return in milliseconds, admitted streams make goodput.
+        _bench_serve_overload(extra_details)
 
     ratios = {k: results[k] / BASELINES[k] for k in BASELINES if k in results}
     # put-GB/s is bounded by this host's memcpy bandwidth (one mandatory
@@ -1076,6 +1082,197 @@ def _bench_serve_decode_e2e(details: dict):
     details["serve_decode_e2e_tok_s"] = round(e2e_med, 1)
     details["serve_decode_e2e_ratio"] = round(ratio, 3)
     details["serve_decode_e2e_bound"] = bound
+
+
+def _bench_serve_overload(details: dict):
+    """Overload & admission control lane (smoke only; README "Overload &
+    admission control"). Two measurements:
+
+    1. serve_admission A/B — handle-path requests/s with the admission
+       plane armed vs RT_SERVE_ADMISSION=0 on the SAME cluster (the env
+       flip switches the router's assign path, which is where the
+       admission cost lives), through the shared interleaved-pairs
+       estimator: admission must be free when budgets aren't binding.
+    2. serve_overload storm — dozens of SSE clients with heavy-tailed
+       lengths at ~10x a capped LLM deployment's capacity: every client
+       must RESOLVE (admitted stream or typed shed), queue-full sheds
+       must return in milliseconds (well under one decode-chunk
+       interval), and admitted streams must make goodput.
+    """
+    import json as _json
+    import socket
+    import statistics
+    import threading
+    import urllib.error
+    import urllib.request
+
+    try:
+        import ray_tpu
+        from ray_tpu import serve
+
+        # --- 1. admission on/off A/B on the handle path ------------------
+        ray_tpu.init(num_cpus=4)
+
+        @serve.deployment(max_ongoing_requests=64)
+        def _echo(request=None):
+            return 0
+
+        handle = serve.run(_echo.bind(), route_prefix="/echo",
+                           port=_free_port_bench())
+        handle.remote().result(timeout_s=60)  # warm
+
+        n_req = 150
+        saved = os.environ.get("RT_SERVE_ADMISSION")
+
+        def run_once(leg_on: bool) -> float:
+            # The driver resolves RT_* env at access time: flipping it
+            # here swaps the router between the admission queue and the
+            # byte-identical legacy path without restarting the cluster.
+            os.environ["RT_SERVE_ADMISSION"] = "1" if leg_on else "0"
+            try:
+                t0 = time.perf_counter()
+                for _ in range(n_req):
+                    if handle.remote().result(timeout_s=60) != 0:
+                        raise RuntimeError("echo mismatch")
+                return n_req / (time.perf_counter() - t0)
+            finally:
+                if saved is None:
+                    os.environ.pop("RT_SERVE_ADMISSION", None)
+                else:
+                    os.environ["RT_SERVE_ADMISSION"] = saved
+
+        _ab_overhead_lane("serve_admission", run_once, details, pairs=2)
+        serve.shutdown()
+
+        # --- 2. overload storm against a capped LLM deployment -----------
+        from ray_tpu.llm import LLMConfig
+        from ray_tpu.llm.openai import build_openai_app
+
+        app = build_openai_app(
+            LLMConfig(vocab_size=384, d_model=64, n_layers=2, n_heads=4,
+                      max_seq=256),
+            max_batch=4, decode_chunk=4, max_ongoing_requests=4,
+            max_queued_requests=8, queue_deadline_s=1.5)
+        port = _free_port_bench()
+        serve.run(app, route_prefix="/", port=port)
+        base = f"http://127.0.0.1:{port}"
+        warm = _json.dumps({"prompt": "bench", "max_tokens": 2,
+                            "temperature": 0.0}).encode()
+        urllib.request.urlopen(urllib.request.Request(
+            f"{base}/v1/completions", data=warm,
+            headers={"Content-Type": "application/json"}),
+            timeout=300).read()
+
+        # Warm the CONCURRENT shapes too: batch sizes 1..4 each compile a
+        # fresh program, and a compile landing mid-storm would hold the
+        # executing slots past the queue deadline and starve admission.
+        def _warm_stream():
+            body = _json.dumps({"prompt": "bench", "max_tokens": 8,
+                                "temperature": 0.0,
+                                "stream": True}).encode()
+            urllib.request.urlopen(urllib.request.Request(
+                f"{base}/v1/completions", data=body,
+                headers={"Content-Type": "application/json"}),
+                timeout=300).read()
+
+        wts = [threading.Thread(target=_warm_stream, daemon=True)
+               for _ in range(4)]
+        for t in wts:
+            t.start()
+        for t in wts:
+            t.join(timeout=300)
+
+        n_clients = 40  # vs capacity 4 executing + 8 queued: ~10x load
+        # Heavy-tailed lengths: mostly short, a few long stragglers.
+        lengths = ([8] * 30 + [32] * 8 + [96] * 2)
+        results: list[tuple] = []
+        lock = threading.Lock()
+
+        def client(i: int):
+            t0 = time.perf_counter()
+            body = _json.dumps({"prompt": "bench",
+                                "max_tokens": lengths[i],
+                                "temperature": 0.0,
+                                "stream": True}).encode()
+            req = urllib.request.Request(
+                f"{base}/v1/completions", data=body,
+                headers={"Content-Type": "application/json"})
+            try:
+                n = 0
+                with urllib.request.urlopen(req, timeout=120) as r:
+                    for line in r:
+                        line = line.decode().strip()
+                        if not line.startswith("data: "):
+                            continue
+                        if line[6:] == "[DONE]":
+                            break
+                        n += len(_json.loads(line[6:]).get(
+                            "token_ids", []))
+                out = ("ok", n, time.perf_counter() - t0)
+            except urllib.error.HTTPError as e:
+                e.read()
+                out = ("shed", e.code, time.perf_counter() - t0)
+            except Exception as e:
+                out = ("err", repr(e), time.perf_counter() - t0)
+            with lock:
+                results.append(out)
+
+        t0 = time.perf_counter()
+        ts = [threading.Thread(target=client, args=(i,), daemon=True)
+              for i in range(n_clients)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=180)
+        wall = time.perf_counter() - t0
+        serve.shutdown()
+        ray_tpu.shutdown()
+
+        ok = [r for r in results if r[0] == "ok"]
+        shed = [r for r in results if r[0] == "shed"]
+        errs = [r for r in results if r[0] == "err"]
+        if len(results) != n_clients or errs:
+            raise RuntimeError(
+                f"storm left {n_clients - len(results)} hung / "
+                f"{len(errs)} untyped clients: {errs[:3]}")
+        # 429s are immediate sheds (queue full / replica busy); 503s
+        # waited out the 1.5s queue deadline. Both are RESOLUTIONS.
+        fast_ms = sorted((r[2] * 1000.0 for r in shed if r[1] == 429))
+        tokens = sum(r[1] for r in ok)
+    except Exception as e:
+        log(f"  serve_overload skipped: {e}")
+        try:
+            import ray_tpu
+
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        return
+    log(f"  serve_overload: {len(ok)}/{n_clients} admitted, "
+        f"{len(shed)} shed ({len(fast_ms)} fast), "
+        f"{tokens / max(wall, 1e-9):,.0f} tok/s goodput over {wall:.1f}s"
+        + (f"; fast-shed p50 {statistics.median(fast_ms):.0f}ms"
+           if fast_ms else ""))
+    details["serve_overload_clients"] = n_clients
+    details["serve_overload_resolved"] = len(results)
+    details["serve_overload_admitted"] = len(ok)
+    details["serve_overload_shed_total"] = len(shed)
+    if fast_ms:
+        details["serve_overload_shed_ms_p50"] = round(
+            statistics.median(fast_ms), 1)
+    details["serve_overload_goodput_tok_s"] = round(
+        tokens / max(wall, 1e-9), 1)
+    if shed:
+        details["serve_overload_shed_s_max"] = round(
+            max(r[2] for r in shed), 2)
+
+
+def _free_port_bench() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
 
 
 def _bench_llm_decode(results: dict):

@@ -112,6 +112,12 @@ KINDS: dict[str, tuple[str, str]] = {
     "serve_scale": ("info", "a serve deployment's replica target changed"),
     "serve_replica_death": ("warning", "a serve replica failed its health "
                                        "check or failed to start"),
+    "serve_overload": ("warning", "a serve deployment's router queue "
+                                  "saturated and began shedding (first "
+                                  "shed after a quiet period)"),
+    "serve_shed": ("warning", "serve admission control shed requests "
+                              "(throttled aggregate; attrs carry the "
+                              "per-reason counts since the last event)"),
     # --- compiled dataflow graphs (driver-emitted) -------------------------
     "dag_compiled": ("info", "a DAG was compiled into persistent stage "
                              "loops wired by pre-negotiated shm channels"),

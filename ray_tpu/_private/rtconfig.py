@@ -262,6 +262,32 @@ _flag("token_ring_bytes", int, 1 << 20)
 # running batch at chunk boundaries, so a new request's prefill compile/
 # dispatch never stalls the decode loop. False restores inline admission.
 _flag("llm_prefill_lane", bool, True)
+# --- serve admission control (README "Overload & admission control") --------
+# Master switch for the serve admission/degradation plane: per-deployment
+# concurrency budgets, bounded router queues with deadlines (sheds raise
+# a typed BackPressureError -> HTTP 429/503 + Retry-After), the per-route
+# token bucket, and jittered replica-death retries. False restores the
+# pre-admission behavior byte-identically — no queue, no shed, no budget
+# fields on routing frames (pinned by test).
+_flag("serve_admission", bool, True)
+# Default queue deadline (seconds) for deployments that do not set
+# queue_deadline_s: a request that cannot be assigned a replica slot
+# within this long is shed, not stalled. Matches the legacy assign
+# timeout so default-on admission changes no existing behavior.
+_flag("serve_queue_deadline_s", float, 30.0)
+# HTTP proxy per-route token bucket refill rate (requests/second);
+# 0 disables rate limiting. Excess requests get 429 + Retry-After
+# before touching the router queue.
+_flag("serve_rps", float, 0.0)
+# Token bucket capacity: bursts up to this many requests pass at once
+# before the refill rate governs.
+_flag("serve_burst", int, 16)
+# Per-request retry budget for replica-death (and cross-router
+# replica-busy) assignment failures: the router re-assigns against
+# surviving replicas up to this many times with jittered backoff.
+_flag("serve_retries", int, 2)
+# Base for the jittered exponential backoff between those retries.
+_flag("serve_retry_base_s", float, 0.05)
 # --- compiled dataflow graphs (README "Compiled graphs") --------------------
 # Max invocations a compiled DAG keeps in flight: execute() returns a
 # DagRef immediately and only blocks once this many invocations are still

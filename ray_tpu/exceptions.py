@@ -90,6 +90,41 @@ class CollectiveTimeoutError(RayTpuError, TimeoutError):
     waiting on."""
 
 
+def _rebuild_back_pressure_error(message, deployment, reason, queued,
+                                 retry_after_s):
+    return BackPressureError(message, deployment=deployment, reason=reason,
+                             queued=queued, retry_after_s=retry_after_s)
+
+
+class BackPressureError(RayTpuError):
+    """A serve request was shed by admission control instead of queued
+    unboundedly (README "Overload & admission control").
+
+    Raised from the router when a deployment's bounded queue is full
+    (`reason="queue_full"`), when a queued request could not be assigned
+    before its `queue_deadline_s` (`reason="deadline"`), from the HTTP
+    proxy's per-route token bucket (`reason="rate_limit"`), or replica-side
+    when a request lands on a replica already at `max_ongoing_requests`
+    (`reason="replica_busy"` — a cross-router race; routers retry these
+    against other replicas). `retry_after_s` is the shed's retry hint — the
+    proxy surfaces it as an HTTP `Retry-After` header on the 429/503.
+    """
+
+    def __init__(self, message: str, *, deployment: str | None = None,
+                 reason: str = "queue_full", queued: int = 0,
+                 retry_after_s: float = 1.0):
+        self.deployment = deployment
+        self.reason = reason
+        self.queued = queued
+        self.retry_after_s = retry_after_s
+        super().__init__(message)
+
+    def __reduce__(self):
+        return (_rebuild_back_pressure_error,
+                (str(self), self.deployment, self.reason, self.queued,
+                 self.retry_after_s))
+
+
 def _rebuild_dag_stage_error(message, stage, node, invocation, traceback_str):
     return DagStageError(message, stage=stage, node=node,
                          invocation=invocation, traceback_str=traceback_str)

@@ -155,14 +155,23 @@ def build_openai_app(cfg: LLMConfig, *, name: str = "llm",
                      model_id: str = "ray-tpu-llm", num_replicas: int = 1,
                      max_batch: int = 8, decode_chunk: int = 8,
                      default_max_tokens: int = 64,
-                     ray_actor_options: Optional[dict] = None):
+                     ray_actor_options: Optional[dict] = None,
+                     max_ongoing_requests: int = 16,
+                     max_queued_requests: int = -1,
+                     queue_deadline_s: Optional[float] = None):
     """Serve application exposing the OpenAI surface (reference
-    build_openai_app, application_builders.py)."""
+    build_openai_app, application_builders.py). The admission budgets
+    (README "Overload & admission control") pass straight through to the
+    deployment: cap ongoing requests near max_batch so excess load sheds
+    fast 429s at the proxy instead of stacking onto the engine's queue."""
     from ray_tpu import serve
 
     dep = serve.deployment(
         OpenAIServer, name=name, num_replicas=num_replicas,
-        ray_actor_options=ray_actor_options)
+        ray_actor_options=ray_actor_options,
+        max_ongoing_requests=max_ongoing_requests,
+        max_queued_requests=max_queued_requests,
+        queue_deadline_s=queue_deadline_s)
     return dep.bind(cfg, model_id=model_id, max_batch=max_batch,
                     decode_chunk=decode_chunk,
                     default_max_tokens=default_max_tokens)

@@ -65,7 +65,9 @@ class Deployment:
                  ray_actor_options: Optional[dict] = None,
                  max_ongoing_requests: int = 16,
                  autoscaling_config: Optional[dict] = None,
-                 version: Optional[str] = None):
+                 version: Optional[str] = None,
+                 max_queued_requests: int = -1,
+                 queue_deadline_s: Optional[float] = None):
         self._func_or_class = func_or_class
         self.name = name
         self.num_replicas = num_replicas
@@ -73,13 +75,22 @@ class Deployment:
         self.max_ongoing_requests = max_ongoing_requests
         self.autoscaling_config = autoscaling_config
         self.version = version
+        # Admission budgets (README "Overload & admission control"):
+        # max_queued_requests bounds the per-router queue behind the
+        # replicas' concurrency caps (-1 = unbounded, the deadline still
+        # sheds); queue_deadline_s caps how long a request may wait for a
+        # slot before it is shed (None = RT_SERVE_QUEUE_DEADLINE_S).
+        self.max_queued_requests = max_queued_requests
+        self.queue_deadline_s = queue_deadline_s
 
     def options(self, **overrides) -> "Deployment":
         cfg = dict(
             name=self.name, num_replicas=self.num_replicas,
             ray_actor_options=self.ray_actor_options,
             max_ongoing_requests=self.max_ongoing_requests,
-            autoscaling_config=self.autoscaling_config, version=self.version)
+            autoscaling_config=self.autoscaling_config, version=self.version,
+            max_queued_requests=self.max_queued_requests,
+            queue_deadline_s=self.queue_deadline_s)
         cfg.update(overrides)
         return Deployment(self._func_or_class, **cfg)
 
@@ -106,6 +117,8 @@ class Deployment:
             "autoscaling_config": autoscaling,
             "ray_actor_options": self.ray_actor_options,
             "max_ongoing_requests": self.max_ongoing_requests,
+            "max_queued_requests": self.max_queued_requests,
+            "queue_deadline_s": self.queue_deadline_s,
             "route_prefix": route_prefix,
             "version": version,
         }
@@ -115,13 +128,16 @@ def deployment(_func_or_class=None, *, name: Optional[str] = None,
                num_replicas=1, ray_actor_options: Optional[dict] = None,
                max_ongoing_requests: int = 16,
                autoscaling_config: Optional[dict] = None,
-               version: Optional[str] = None):
+               version: Optional[str] = None,
+               max_queued_requests: int = -1,
+               queue_deadline_s: Optional[float] = None):
     """@serve.deployment (reference api.py:deployment)."""
 
     def wrap(fc):
         return Deployment(fc, name or fc.__name__, num_replicas,
                           ray_actor_options, max_ongoing_requests,
-                          autoscaling_config, version)
+                          autoscaling_config, version,
+                          max_queued_requests, queue_deadline_s)
 
     if _func_or_class is not None:
         return wrap(_func_or_class)

@@ -142,7 +142,20 @@ class ServeController:
         retire = self._retire_after_ready.get(deployment)
         if retire and not reps:
             reps = [(rid, r["handle"]) for rid, r in retire.items() if r["ready"]]
-        return {"version": self.version, "replicas": reps}
+        out = {"version": self.version, "replicas": reps}
+        from ray_tpu._private.rtconfig import CONFIG
+
+        if CONFIG.serve_admission and st is not None:
+            # Admission budgets ride the same long-poll frame as
+            # membership, so routers learn cap changes exactly when they
+            # learn replica changes. Absent entirely with the plane off —
+            # the frame stays byte-identical to the pre-admission shape.
+            out["budgets"] = {
+                "max_ongoing": int(st.spec.get("max_ongoing_requests", 16)),
+                "max_queued": int(st.spec.get("max_queued_requests", -1)),
+                "queue_deadline_s": st.spec.get("queue_deadline_s"),
+            }
+        return out
 
     async def route_table(self, known_version: int = -1,
                           timeout: float = 10.0) -> dict:
@@ -297,13 +310,26 @@ class ServeController:
         rid = f"{name}#{uuid.uuid4().hex[:6]}"
         opts = dict(spec.get("ray_actor_options") or {})
         opts.setdefault("num_cpus", 1)
-        opts["max_concurrency"] = int(spec.get("max_ongoing_requests", 16))
+        cap = int(spec.get("max_ongoing_requests", 16))
+        opts["max_concurrency"] = cap
+        from ray_tpu._private.rtconfig import CONFIG
         from ray_tpu.serve._private.replica import Replica
 
+        extra: dict = {}
+        if CONFIG.serve_admission:
+            # With admission on, the replica enforces the cap itself
+            # (typed replica_busy rejection the routers retry elsewhere).
+            # The actor concurrency limit gets headroom above the cap so
+            # control calls — stats, drain, the rejection itself — still
+            # run while every request slot is occupied; without it a
+            # saturated replica is also unobservable.
+            opts["max_concurrency"] = cap + 8
+            extra["max_ongoing"] = cap
         actor_cls = ray_tpu.remote(**opts)(Replica)
         handle = actor_cls.remote(name, rid, spec["callable"],
                                   tuple(spec.get("init_args") or ()),
-                                  dict(spec.get("init_kwargs") or {}))
+                                  dict(spec.get("init_kwargs") or {}),
+                                  **extra)
         st.replicas[rid] = {"handle": handle, "ready": False,
                             "ready_ref": handle.ready.remote()}
 

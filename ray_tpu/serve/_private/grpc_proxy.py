@@ -148,6 +148,14 @@ class _Handler:
                 try:
                     yield from self._ingress._call_stream(dep, method, req)
                 except Exception as e:
+                    from ray_tpu.exceptions import BackPressureError
+
+                    if isinstance(e, BackPressureError):
+                        # Shed by admission control: RESOURCE_EXHAUSTED is
+                        # the canonical gRPC back-pressure code (clients
+                        # back off), not INTERNAL (clients report a bug).
+                        context.abort(
+                            grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
                     logger.error("grpc stream %s failed: %r",
                                  handler_call_details.method, e)
                     context.abort(grpc.StatusCode.INTERNAL, repr(e))
@@ -161,6 +169,11 @@ class _Handler:
             try:
                 return self._ingress._call_unary(dep, method, req)
             except Exception as e:
+                from ray_tpu.exceptions import BackPressureError
+
+                if isinstance(e, BackPressureError):
+                    context.abort(
+                        grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
                 logger.error("grpc %s failed: %r",
                              handler_call_details.method, e)
                 context.abort(grpc.StatusCode.INTERNAL, repr(e))

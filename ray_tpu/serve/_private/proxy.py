@@ -13,14 +13,49 @@ import asyncio
 import contextvars
 import json
 import logging
+import math
+import threading
+import time
 from typing import Optional
 
 import ray_tpu
 from ray_tpu._private import tracing as _tracing
+from ray_tpu._private.rtconfig import CONFIG
 from ray_tpu.serve._private.replica import Request
-from ray_tpu.serve._private.router import get_router, resolver_for
+from ray_tpu.serve._private.router import (
+    QueueCancelled,
+    _is_replica_busy,
+    _retry_pause_s,
+    get_router,
+    resolver_for,
+)
 
 logger = logging.getLogger(__name__)
+
+
+class _TokenBucket:
+    """Burst-tolerant per-route rate limiter (RT_SERVE_RPS/RT_SERVE_BURST,
+    README "Overload & admission control"): refills continuously at `rate`
+    tokens/s up to `burst`, so short bursts pass at line rate and only
+    sustained excess is shed — before it ever touches the router queue."""
+
+    __slots__ = ("rate", "burst", "tokens", "stamp")
+
+    def __init__(self, rate: float, burst: int, now: float):
+        self.rate = rate
+        self.burst = burst
+        self.tokens = float(burst)
+        self.stamp = now
+
+    def take(self, now: float) -> float:
+        """0.0 when a token was taken; else seconds until one refills."""
+        self.tokens = min(float(self.burst),
+                          self.tokens + (now - self.stamp) * self.rate)
+        self.stamp = now
+        if self.tokens >= 1.0:
+            self.tokens -= 1.0
+            return 0.0
+        return (1.0 - self.tokens) / max(self.rate, 1e-9)
 
 
 class Proxy:
@@ -36,6 +71,9 @@ class Proxy:
         self._started = False
         self._resolver = None
         self._stream_pool = None  # dedicated: SSE waits pin a thread each
+        # route prefix -> token bucket (RT_SERVE_RPS); rebuilt when the
+        # knobs change so tests can flip rates without a proxy restart.
+        self._buckets: dict[str, _TokenBucket] = {}
         # deployment -> monotonic time of its last ring-handshake nak: a
         # peer that cannot attach (cross-host replica, no shared shm)
         # naks every request, so skip the 1MB ring setup/unlink for a
@@ -52,7 +90,14 @@ class Proxy:
 
         app = web.Application()
         app.router.add_route("*", "/{tail:.*}", self._handle)
-        runner = web.AppRunner(app, access_log=None)
+        # handler_cancellation: aiohttp >= 3.9 no longer cancels handler
+        # tasks when the client disconnects. The admission plane depends
+        # on that cancellation to free QUEUED slots for abandoned
+        # requests, so re-enable it — only with the plane on, keeping the
+        # legacy path byte-identical.
+        runner = web.AppRunner(app, access_log=None,
+                               handler_cancellation=bool(
+                                   CONFIG.serve_admission))
         await runner.setup()
         site = web.TCPSite(runner, self.host, self.port)
         await site.start()
@@ -116,6 +161,96 @@ class Proxy:
                     best = (norm, dep)
         return best
 
+    def _pool(self):
+        if self._stream_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            # NOT the default executor: each active stream parks a thread
+            # in next() for its whole lifetime — and with admission on,
+            # queued assigns park one up to the deadline — so exhausting
+            # the shared pool would stall every other run_in_executor user
+            # (route polls, legacy assigns) behind long waits.
+            self._stream_pool = ThreadPoolExecutor(
+                max_workers=256, thread_name_prefix="rt-sse")
+        return self._stream_pool
+
+    def _bucket_shed(self, prefix: str, dep: str):
+        """Front-door rate limit: returns a 429 response when the route's
+        token bucket is dry, None to admit. Off unless RT_SERVE_RPS > 0."""
+        rate = float(CONFIG.serve_rps)
+        if rate <= 0:
+            return None
+        burst = max(1, int(CONFIG.serve_burst))
+        now = time.monotonic()
+        b = self._buckets.get(prefix)
+        if b is None or b.rate != rate or b.burst != burst:
+            b = self._buckets[prefix] = _TokenBucket(rate, burst, now)
+        wait = b.take(now)
+        if wait <= 0.0:
+            return None
+        try:
+            # Rides the router's shed accounting so /v1/stats shed_total
+            # and the rt_serve_shed metric cover front-door rejections too.
+            get_router(self.controller_name, dep).record_shed("rate_limit")
+        except Exception:
+            pass
+        from ray_tpu.exceptions import BackPressureError
+
+        return self._shed_response(BackPressureError(
+            f"route {prefix!r} over its rate limit "
+            f"({rate:g} req/s, burst {burst})",
+            deployment=dep, reason="rate_limit", retry_after_s=wait))
+
+    @staticmethod
+    def _shed_response(e):
+        """Map a BackPressureError to HTTP: 429 for loads the client can
+        back off from (rate limit, full queue, busy replicas), 503 for a
+        request that already burned its queue deadline. Both carry
+        Retry-After so well-behaved clients pace themselves."""
+        from aiohttp import web
+
+        status = 503 if e.reason == "deadline" else 429
+        retry_after = max(1, math.ceil(float(e.retry_after_s or 1.0)))
+        return web.json_response(
+            {"error": {"type": "BackPressureError", "reason": e.reason,
+                       "deployment": e.deployment, "queued": e.queued,
+                       "retry_after_s": e.retry_after_s,
+                       "message": str(e)}},
+            status=status, headers={"Retry-After": str(retry_after)})
+
+    @staticmethod
+    def _death_response(dep: str, replica_id, e):
+        """Replica died mid-request and the retry budget is spent: 503
+        (retriable — the controller is already restarting it), naming the
+        replica and where its fate is recorded. Distinct from the shed
+        429s: THIS request was admitted and lost, not rejected."""
+        from aiohttp import web
+
+        entity = replica_id or dep
+        return web.json_response(
+            {"error": {"type": type(e).__name__, "deployment": dep,
+                       "replica": replica_id, "retriable": True,
+                       "detail": str(e) or repr(e),
+                       "events": f"ray-tpu events --entity {entity}"}},
+            status=503, headers={"Retry-After": "1"})
+
+    @staticmethod
+    def _stream_error_payload(dep: str, replica_id, e) -> dict:
+        """Structured SSE error event: once streaming has begun the status
+        line is gone, so mid-stream replica death is reported in-band —
+        typed, naming the replica and its event-plane entity — instead of
+        a bare repr the client can only string-match."""
+        from ray_tpu.exceptions import ActorDiedError, WorkerCrashedError
+
+        err = {"type": type(e).__name__, "deployment": dep,
+               "detail": str(e) or repr(e)}
+        if isinstance(e, (ActorDiedError, WorkerCrashedError)):
+            entity = replica_id or dep
+            err["replica"] = replica_id
+            err["retriable"] = True
+            err["events"] = f"ray-tpu events --entity {entity}"
+        return {"error": err}
+
     async def _handle(self, request):
         from aiohttp import web
 
@@ -123,6 +258,16 @@ class Proxy:
         if m is None:
             return web.Response(status=404, text="no deployment matches path")
         _prefix, dep = m
+        admission = bool(CONFIG.serve_admission)
+        # Stats requests bypass both the token bucket and the admission
+        # queue: observability must stay readable exactly when the
+        # deployment is saturated, or overloads can't be diagnosed.
+        is_stats = (request.method == "GET"
+                    and request.path.rstrip("/").endswith("/stats"))
+        if admission and not is_stats:
+            shed = self._bucket_shed(_prefix, dep)
+            if shed is not None:
+                return shed
         body = await request.read()
         # Trace root: an ingress request roots its own trace (head-based
         # RT_TRACE_SAMPLE; slow unsampled requests escalate via
@@ -163,38 +308,119 @@ class Proxy:
                     trh, f"http {request.method} {request.path}",
                     {"deployment": dep, "stream": True})
 
+        cancel = threading.Event() if admission else None
+        meta: dict = {}
+
         async def _once():
-            # assign only blocks when there are no replicas (rare), so the
-            # executor thread is held for microseconds, not the request
-            # duration; the result await costs no thread at all.
+            # Legacy path: assign only blocks when there are no replicas
+            # (rare), so the default executor thread is held for
+            # microseconds, not the request duration; the result await
+            # costs no thread at all. Admission path: assign can park in
+            # the bounded queue up to the deadline, so it rides the
+            # dedicated pool and honors the client-disconnect cancel.
             # run_in_executor does NOT propagate contextvars (the trace
             # context, like the multiplexed id in replica.py): copy it in.
             pctx = contextvars.copy_context()
-            ref = await loop.run_in_executor(
-                None, lambda: pctx.run(
-                    router.assign, "__call__", (req,), {},
-                    multiplexed_model_id=model_id))
+            if admission:
+                fut = loop.run_in_executor(
+                    self._pool(), lambda: pctx.run(
+                        router.assign, "__call__", (req,), {},
+                        multiplexed_model_id=model_id,
+                        cancel=cancel, meta=meta,
+                        bypass_queue=is_stats))
+                try:
+                    ref = await fut
+                except asyncio.CancelledError:
+                    # Client gone while (possibly) queued: release the
+                    # queue slot; the parked thread notices within its
+                    # 100ms poll. Consume the future's eventual
+                    # QueueCancelled so it isn't logged as unretrieved.
+                    cancel.set()
+                    fut.add_done_callback(
+                        lambda f: f.cancelled() or f.exception())
+                    raise
+            else:
+                ref = await loop.run_in_executor(
+                    None, lambda: pctx.run(
+                        router.assign, "__call__", (req,), {},
+                        multiplexed_model_id=model_id))
             return await self._resolver.submit(ref)
 
         try:
-            try:
-                result = await _once()
-            except Exception as e:
-                from ray_tpu.exceptions import (
-                    ActorDiedError,
-                    WorkerCrashedError,
-                )
+            if not admission:
+                try:
+                    result = await _once()
+                except Exception as e:
+                    from ray_tpu.exceptions import (
+                        ActorDiedError,
+                        WorkerCrashedError,
+                    )
 
-                if isinstance(e, (ActorDiedError, WorkerCrashedError)):
-                    # replica died mid-request: retry once on a survivor
+                    if isinstance(e, (ActorDiedError, WorkerCrashedError)):
+                        # replica died mid-request: retry once on a survivor
+                        try:
+                            result = await _once()
+                            return self._to_response(result)
+                        except Exception as e2:  # noqa: F841
+                            e = e2
+                    logger.error("serve proxy error: %r", e)
+                    return web.Response(status=500, text=repr(e))
+                return self._to_response(result)
+            from ray_tpu.exceptions import (
+                ActorDiedError,
+                BackPressureError,
+                WorkerCrashedError,
+            )
+
+            try:
+                retries = max(0, int(CONFIG.serve_retries))
+                for attempt in range(retries + 1):
                     try:
                         result = await _once()
-                        return self._to_response(result)
-                    except Exception as e2:  # noqa: F841
-                        e = e2
+                        break
+                    except (ActorDiedError, WorkerCrashedError):
+                        # Replica died mid-request: jittered backoff, then
+                        # re-admit against the survivors — until the
+                        # per-request retry budget (RT_SERVE_RETRIES) runs
+                        # out.
+                        if attempt >= retries:
+                            raise
+                        await asyncio.sleep(_retry_pause_s(attempt))
+                    except Exception as e:
+                        # A replica-side concurrency-cap rejection (a race
+                        # between routers) is retriable; real application
+                        # errors are not. It crosses the wire wrapped in
+                        # TaskError — unwrap so exhaustion still maps to
+                        # 429, not 500.
+                        if not _is_replica_busy(e):
+                            raise
+                        if attempt >= retries:
+                            # Replica-raised: this router never counted it
+                            # (its own slot view was free), so account the
+                            # shed here before surfacing the 429.
+                            router.record_shed("replica_busy")
+                            cause = getattr(e, "cause", None)
+                            raise cause if isinstance(
+                                cause, BackPressureError) else e
+                        await asyncio.sleep(_retry_pause_s(attempt))
+                if is_stats and isinstance(result, dict):
+                    serve_stats = router.admission_stats()
+                    if serve_stats is not None:
+                        result = dict(result)
+                        result["serve"] = serve_stats
+                return self._to_response(result)
+            except BackPressureError as e:
+                return self._shed_response(e)
+            except (ActorDiedError, WorkerCrashedError) as e:
+                logger.error("serve proxy error (replica death): %r", e)
+                return self._death_response(dep, meta.get("replica_id"), e)
+            except QueueCancelled:
+                # Client disconnected while queued; the handler task is
+                # normally cancelled before this surfaces — treat alike.
+                raise asyncio.CancelledError()
+            except Exception as e:
                 logger.error("serve proxy error: %r", e)
                 return web.Response(status=500, text=repr(e))
-            return self._to_response(result)
         finally:
             _tracing.end_request(trh, f"http {request.method} {request.path}",
                                  {"deployment": dep})
@@ -270,8 +496,6 @@ class Proxy:
         reply path byte-identically."""
         from aiohttp import web
 
-        from ray_tpu._private.rtconfig import CONFIG
-
         ring = None
         ring_spec = None
         if CONFIG.token_ring and (
@@ -289,16 +513,44 @@ class Proxy:
                 logger.debug("token ring unavailable (%r): classic path", e)
                 ring = None
                 ring_spec = None
+        admission = bool(CONFIG.serve_admission)
+        cancel = threading.Event() if admission else None
+        meta: dict = {}
         try:
             pctx = contextvars.copy_context()  # carry the trace context
-            gen = await loop.run_in_executor(
-                None, lambda: pctx.run(
-                    router.assign, "__call__", (req,), {},
-                    multiplexed_model_id=model_id, streaming=True,
-                    stream_ring=ring_spec))
+            if admission:
+                gen = await self._assign_stream(router, req, model_id,
+                                                ring_spec, loop, pctx,
+                                                cancel, meta)
+            else:
+                gen = await loop.run_in_executor(
+                    None, lambda: pctx.run(
+                        router.assign, "__call__", (req,), {},
+                        multiplexed_model_id=model_id, streaming=True,
+                        stream_ring=ring_spec))
+        except asyncio.CancelledError:
+            if ring is not None:
+                ring.close(unlink=True)
+            raise
         except Exception as e:
             if ring is not None:
                 ring.close(unlink=True)
+            if admission:
+                from ray_tpu.exceptions import (
+                    ActorDiedError,
+                    BackPressureError,
+                    WorkerCrashedError,
+                )
+
+                # The status line is still ours pre-stream: sheds and
+                # replica death map to typed 429/503 rather than SSE.
+                if isinstance(e, BackPressureError):
+                    return self._shed_response(e)
+                if isinstance(e, (ActorDiedError, WorkerCrashedError)):
+                    logger.error(
+                        "serve proxy stream error (replica death): %r", e)
+                    return self._death_response(
+                        router.deployment, meta.get("replica_id"), e)
             logger.error("serve proxy stream assign error: %r", e)
             return web.Response(status=500, text=repr(e))
         resp = web.StreamResponse(headers={
@@ -306,15 +558,7 @@ class Proxy:
             "Cache-Control": "no-cache",
             "Connection": "keep-alive"})
         await resp.prepare(request)
-        if self._stream_pool is None:
-            from concurrent.futures import ThreadPoolExecutor
-
-            # NOT the default executor: each active stream parks a thread
-            # in next() for its whole lifetime, and exhausting the shared
-            # pool would stall every other run_in_executor user (assigns,
-            # route polls) behind long LLM token streams.
-            self._stream_pool = ThreadPoolExecutor(
-                max_workers=256, thread_name_prefix="rt-sse")
+        self._pool()
         it = iter(gen)
         sentinel = object()
         try:
@@ -353,8 +597,13 @@ class Proxy:
             # must not raise uncaught (they'd leak the stream below).
             logger.debug("serve proxy stream ended early: %r", e)
             try:
+                if admission:
+                    payload = self._stream_error_payload(
+                        router.deployment, meta.get("replica_id"), e)
+                else:
+                    payload = {"error": repr(e)}
                 await resp.write(
-                    f"data: {json.dumps({'error': repr(e)})}\n\n".encode())
+                    f"data: {json.dumps(payload)}\n\n".encode())
                 await resp.write(b"data: [DONE]\n\n")
                 await resp.write_eof()
             except Exception:
@@ -369,6 +618,39 @@ class Proxy:
             if ring is not None:
                 ring.close(unlink=True)
         return resp
+
+    async def _assign_stream(self, router, req, model_id, ring_spec, loop,
+                             pctx, cancel, meta):
+        """Admission-path streaming assign: rides the dedicated pool (it
+        may park in the bounded queue up to the deadline), frees the queue
+        slot if the client disconnects while waiting, and retries
+        replica-busy races under the RT_SERVE_RETRIES budget."""
+        retries = max(0, int(CONFIG.serve_retries))
+        for attempt in range(retries + 1):
+            fut = loop.run_in_executor(
+                self._pool(), lambda: pctx.run(
+                    router.assign, "__call__", (req,), {},
+                    multiplexed_model_id=model_id, streaming=True,
+                    stream_ring=ring_spec, cancel=cancel, meta=meta))
+            try:
+                return await fut
+            except asyncio.CancelledError:
+                cancel.set()
+                fut.add_done_callback(
+                    lambda f: f.cancelled() or f.exception())
+                raise
+            except Exception as e:
+                from ray_tpu.exceptions import (
+                    ActorDiedError,
+                    WorkerCrashedError,
+                )
+
+                retriable = (isinstance(e, (ActorDiedError,
+                                            WorkerCrashedError))
+                             or _is_replica_busy(e))
+                if not retriable or attempt >= retries:
+                    raise
+                await asyncio.sleep(_retry_pause_s(attempt))
 
     def _to_response(self, result):
         from aiohttp import web

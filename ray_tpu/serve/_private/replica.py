@@ -52,7 +52,7 @@ class Replica:
     deployment's resource options)."""
 
     def __init__(self, deployment: str, replica_id: str, callable_or_class,
-                 init_args: tuple, init_kwargs: dict):
+                 init_args: tuple, init_kwargs: dict, max_ongoing: int = 0):
         self.deployment = deployment
         self.replica_id = replica_id
         if isinstance(callable_or_class, type):
@@ -61,6 +61,14 @@ class Replica:
             self.callable = callable_or_class
         self.ongoing = 0
         self.total = 0
+        # Hard cap on concurrently executing requests (0 = uncapped, the
+        # pre-admission behavior). Routers reserve slots before they
+        # dispatch, so rejections here only fire on cross-router races —
+        # several routers each under their own count can still overshoot
+        # the replica. The typed replica_busy rejection sends the request
+        # back to the router's retry path instead of silently queueing it
+        # on a saturated event loop.
+        self.max_ongoing = int(max_ongoing)
         self._stream_pool = None  # lazy; see handle_request_streaming
         # EMA of request latency (ms): the target-latency autoscaling
         # signal (reference autoscaling_policy latency-based variants).
@@ -71,8 +79,24 @@ class Replica:
         readiness barrier before a replica enters the routing table."""
         return self.replica_id
 
+    def _admit_or_raise(self):
+        if self.max_ongoing > 0 and self.ongoing >= self.max_ongoing:
+            from ray_tpu.exceptions import BackPressureError
+
+            raise BackPressureError(
+                f"replica {self.replica_id} is at its concurrency cap "
+                f"({self.ongoing}/{self.max_ongoing} ongoing)",
+                deployment=self.deployment, reason="replica_busy",
+                queued=0, retry_after_s=0.1)
+
     async def handle_request(self, method_name: str, args: tuple, kwargs: dict,
-                             multiplexed_model_id: str = ""):
+                             multiplexed_model_id: str = "",
+                             bypass_cap: bool = False):
+        # bypass_cap: operator introspection (stats probes) must succeed
+        # exactly when the replica is saturated — the actor's concurrency
+        # headroom (controller: cap + 8) keeps a lane open for them.
+        if not bypass_cap:
+            self._admit_or_raise()
         self.ongoing += 1
         self.total += 1
         _t0 = asyncio.get_event_loop().time()
@@ -171,7 +195,8 @@ class Replica:
     async def handle_request_streaming(self, method_name: str, args: tuple,
                                        kwargs: dict,
                                        multiplexed_model_id: str = "",
-                                       stream_ring: Optional[dict] = None):
+                                       stream_ring: Optional[dict] = None,
+                                       bypass_cap: bool = False):
         """Streaming twin of handle_request: the user method returns an
         (async) generator/iterable whose items are yielded incrementally to
         the caller over the core streaming-generator transport (reference
@@ -184,6 +209,8 @@ class Replica:
         record — zero per-item ObjectRefs, per-item RPC, or per-item
         owner bookkeeping on the reply path. Without the kwarg this
         method is byte-identical to the classic path."""
+        if not bypass_cap:
+            self._admit_or_raise()
         self.ongoing += 1
         self.total += 1
         _t0 = asyncio.get_event_loop().time()
@@ -286,8 +313,13 @@ class Replica:
         max_ongoing_requests semaphore, and the autoscaler must see the
         true ongoing count exactly when the replica is saturated (sync
         methods run on the exec thread / thread pool, not the loop)."""
-        return {"replica_id": self.replica_id, "ongoing": self.ongoing,
-                "total": self.total, "ema_latency_ms": self.ema_latency_ms}
+        out = {"replica_id": self.replica_id, "ongoing": self.ongoing,
+               "total": self.total, "ema_latency_ms": self.ema_latency_ms}
+        if self.max_ongoing > 0:
+            # Only with admission on (the controller passes the cap then):
+            # the stats frame stays byte-identical with the plane off.
+            out["max_ongoing"] = self.max_ongoing
+        return out
 
     async def drain(self, timeout_s: float = 10.0) -> bool:
         """Wait for in-flight requests to finish (reference graceful

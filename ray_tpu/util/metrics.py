@@ -327,6 +327,20 @@ CHECKPOINT_COMMITTED = Counter(
     "rt_checkpoint_committed_total",
     description="checkpoints committed (manifest rename succeeded)")
 
+#: Serve admission control (README "Overload & admission control"), minted
+#: router-side (proxy process or handle owner). Sheds are the plane working
+#: as designed under overload; a nonzero rate at NOMINAL load means budgets
+#: are set too tight. Queue depth is the per-deployment router backlog —
+#: pinned at max_queued_requests while shedding, draining to zero after.
+SERVE_SHED = Counter(
+    "rt_serve_shed_total",
+    description="serve requests shed by admission control",
+    tag_keys=("deployment", "reason"))
+SERVE_QUEUE_DEPTH = Gauge(
+    "rt_serve_queue_depth",
+    description="requests waiting in this router's deployment queue",
+    tag_keys=("deployment",))
+
 #: Per-attempt execution deadlines that fired (@remote(timeout_s=...)),
 #: minted worker-side as the deadline interrupts the attempt. A non-zero
 #: rate under a healthy workload means timeout_s is set too tight — or

@@ -144,3 +144,39 @@ def test_bench_smoke_runs():
         f"end-to-end streaming decode is {s_ratio}x of the isolated "
         f"engine ({e2e} vs {iso} tok/s medians) — the serving path is "
         f"eating throughput again (gate bound {s_bound}x)")
+    # Admission control A/B (ISSUE 17 acceptance): the armed-but-not-
+    # binding admission plane must cost nothing on the handle path vs
+    # RT_SERVE_ADMISSION=0 (median-of-interleaved-pairs ratio, noise-
+    # widened bound — README "Overload & admission control").
+    a_off = rep["details"].get("serve_admission_off_tasks_s")
+    a_on = rep["details"].get("serve_admission_on_tasks_s")
+    assert a_off and a_on, (
+        "serve_admission A/B missing (bench skipped it: see its stderr)")
+    a_bound = rep["details"]["serve_admission_overhead_bound"]
+    assert rep["details"]["serve_admission_overhead"] <= a_bound, (
+        f"admission plane costs {rep['details']['serve_admission_overhead']}"
+        f"x on the handle path (off {a_off}/s vs on {a_on}/s medians) — "
+        f"budget is 1.05x (noise-widened gate: {a_bound}x)")
+    # Overload storm (ISSUE 17 acceptance): ~10x load on a capped LLM
+    # deployment — EVERY client resolves (admitted or typed shed, zero
+    # hangs), overload sheds exist, queue-full sheds return in
+    # milliseconds (well under a decode-chunk interval), and the sheds
+    # protect real goodput for the admitted streams.
+    o_clients = rep["details"].get("serve_overload_clients")
+    assert o_clients, (
+        "serve_overload lane missing (bench skipped it: see its stderr)")
+    assert rep["details"]["serve_overload_resolved"] == o_clients, (
+        f"{o_clients - rep['details']['serve_overload_resolved']} clients "
+        f"hung under overload — shed-not-stall is broken")
+    assert rep["details"]["serve_overload_shed_total"] > 0, (
+        "10x overload shed nothing — admission budgets are not binding")
+    assert rep["details"]["serve_overload_admitted"] > 0, (
+        "overload admitted nothing — the deployment is unavailable, "
+        "not overloaded")
+    shed_p50 = rep["details"].get("serve_overload_shed_ms_p50")
+    if shed_p50 is not None:
+        assert shed_p50 < 250.0, (
+            f"queue-full sheds take {shed_p50}ms at median — rejection "
+            f"must be immediate, not queued behind the overload")
+    assert rep["details"]["serve_overload_goodput_tok_s"] > 0, (
+        "admitted streams made no goodput under overload")

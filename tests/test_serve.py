@@ -395,3 +395,137 @@ def test_autoscale_target_latency(ray_start_4cpu):
         assert scaled, serve.status()
     finally:
         serve.shutdown()
+
+
+# --------------------------------------------------------------- admission
+def test_admission_shed_typed_and_http_429(serve_shutdown):
+    """Overload & admission control: with the concurrency cap and queue
+    both full, excess requests shed a typed BackPressureError (handle
+    path) / 429 + Retry-After (HTTP path) promptly — shed, not stalled."""
+    from ray_tpu.exceptions import BackPressureError
+
+    ray_tpu.init(num_cpus=4)
+
+    @serve.deployment(max_ongoing_requests=1, max_queued_requests=0)
+    class Slow:
+        def __call__(self, request=None):
+            if getattr(request, "path", "").rstrip("/").endswith("/stats"):
+                return {"pid": os.getpid()}
+            time.sleep(3.0)
+            return "done"
+
+    port = _free_port()
+    handle = serve.run(Slow.bind(), port=port)
+    first = handle.remote()  # occupies the only executing slot
+    time.sleep(0.3)
+    # Handle path: this router's slot table is full -> immediate
+    # queue_full shed (max_queued_requests=0 means no waiting room).
+    t0 = time.monotonic()
+    with pytest.raises(BackPressureError) as ei:
+        handle.remote()
+    shed_s = time.monotonic() - t0
+    assert ei.value.reason == "queue_full"
+    assert ei.value.deployment == "Slow"
+    assert ei.value.retry_after_s > 0
+    assert shed_s < 1.0, f"queue-full shed took {shed_s:.2f}s"
+    # HTTP path: the proxy's router dispatches (its own slot table is
+    # empty), the replica's hard cap rejects, the retry budget burns out
+    # -> 429 with Retry-After, typed JSON body.
+    err = None
+    try:
+        _http(f"http://127.0.0.1:{port}/", timeout=20)
+    except urllib.error.HTTPError as e:
+        err = e
+    assert err is not None, "overloaded request should not succeed"
+    assert err.code == 429, err.code
+    assert int(err.headers["Retry-After"]) >= 1
+    body = json.loads(err.read())
+    assert body["error"]["type"] == "BackPressureError"
+    assert body["error"]["reason"] in ("queue_full", "replica_busy")
+    # Stats stay readable exactly while the deployment is saturated, and
+    # the proxy merges router admission stats under "serve".
+    st = json.loads(_http(f"http://127.0.0.1:{port}/stats", timeout=20))
+    assert "serve" in st, st
+    assert st["serve"]["max_ongoing_requests"] == 1
+    assert st["serve"]["max_queued_requests"] == 0
+    assert st["serve"]["shed_total"] >= 1
+    assert first.result(timeout_s=30) == "done"
+
+
+def test_admission_off_pins_legacy_behavior(serve_shutdown, monkeypatch):
+    """RT_SERVE_ADMISSION=0 restores the pre-admission plane: the routing
+    frame carries no budgets key, stats responses gain no serve key, and
+    budgets that WOULD shed are inert (requests queue and succeed)."""
+    monkeypatch.setenv("RT_SERVE_ADMISSION", "0")
+    ray_tpu.init(num_cpus=4)
+
+    @serve.deployment(max_ongoing_requests=1, max_queued_requests=0)
+    class Slow:
+        def __call__(self, request=None):
+            if getattr(request, "path", "").rstrip("/").endswith("/stats"):
+                return {"pid": os.getpid()}
+            time.sleep(0.3)
+            return "ok"
+
+    port = _free_port()
+    handle = serve.run(Slow.bind(), port=port)
+    from ray_tpu.serve._private.controller import CONTROLLER_NAME
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    frame = ray_tpu.get(controller.get_routing.remote("Slow", -1, 0.0))
+    assert "budgets" not in frame, frame
+    # With the plane off these WOULD-shed requests all queue and succeed.
+    resps = [handle.remote() for _ in range(4)]
+    assert [r.result(timeout_s=60) for r in resps] == ["ok"] * 4
+    st = json.loads(_http(f"http://127.0.0.1:{port}/stats"))
+    assert "serve" not in st, st
+
+
+def test_admission_queued_client_disconnect_frees_slot(serve_shutdown):
+    """A client that disconnects while its request is still QUEUED must
+    release the queue slot (cancel event -> QueueCancelled) so the queue
+    drains to zero while the occupying request is still executing."""
+    ray_tpu.init(num_cpus=4)
+
+    @serve.deployment(max_ongoing_requests=1, max_queued_requests=4,
+                      queue_deadline_s=30.0)
+    class Slow:
+        def __call__(self, request=None):
+            if getattr(request, "path", "").rstrip("/").endswith("/stats"):
+                return {"pid": os.getpid()}
+            time.sleep(4.0)
+            return "done"
+
+    port = _free_port()
+    serve.run(Slow.bind(), port=port)
+    # Occupy the slot THROUGH THE PROXY so its router's slot table (the
+    # one the raw-socket request below queues against) is full.
+    import threading
+
+    first_result = {}
+
+    def _first():
+        first_result["body"] = _http(f"http://127.0.0.1:{port}/", timeout=30)
+
+    t = threading.Thread(target=_first, daemon=True)
+    t.start()
+    time.sleep(0.5)
+
+    def queued_depth():
+        st = json.loads(_http(f"http://127.0.0.1:{port}/stats", timeout=10))
+        return st["serve"]["queued"]
+
+    # Raw socket: send a request that will park in the admission queue,
+    # then slam the connection shut while it is still queued.
+    s = socket.create_connection(("127.0.0.1", port), timeout=5)
+    s.sendall(b"GET /work HTTP/1.1\r\nHost: x\r\nConnection: close\r\n\r\n")
+    deadline = time.time() + 10
+    while queued_depth() < 1 and time.time() < deadline:
+        time.sleep(0.05)
+    assert queued_depth() >= 1, "request never reached the queue"
+    s.close()  # client gone; its queue slot must free promptly
+    deadline = time.time() + 10
+    while queued_depth() > 0 and time.time() < deadline:
+        time.sleep(0.05)
+    assert queued_depth() == 0, "disconnected client left a queue slot"
+    t.join(timeout=30)
+    assert first_result.get("body") == b"done"
