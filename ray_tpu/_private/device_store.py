@@ -103,6 +103,22 @@ _TABLE = DeviceObjectTable()
 _warned: set[str] = set()
 _conn_lock = threading.Lock()
 _conns: dict[tuple, object] = {}  # producer addr -> cached rpc.Connection
+# Resolve-tier counters (GIL-atomic int bumps, no lock): how this
+# process's placeholder resolutions landed. The llm_pipeline_decode bench
+# gate reads these off every stage actor to PROVE the zero-RPC steady
+# state — `export_rpc` and `fetch` must stay at 0 when producers export
+# eagerly at publish time (dag._EdgePublisher).
+_RESOLVE_STATS = {"tier0": 0, "store_hit": 0, "export_rpc": 0, "fetch": 0,
+                  "edge_pins": 0}
+
+
+def resolve_stats() -> dict:
+    return dict(_RESOLVE_STATS)
+
+
+def reset_resolve_stats() -> None:
+    for k in _RESOLVE_STATS:
+        _RESOLVE_STATS[k] = 0
 # Fired (from any thread) after every pin/discard/clear so the hosting
 # process can report 0<->nonzero residency transitions (worker_proc tells
 # its node agent, which exempts pinned pool workers from the idle reap).
@@ -139,11 +155,14 @@ def _warn_once(key: str, msg: str) -> None:
 
 
 # ------------------------------------------------------------- eligibility
-def eligible(value) -> bool:
+def eligible(value, min_bytes: "int | None" = None) -> bool:
     """True iff `value` should ride the device plane: a live, single-device,
     fully-addressable jax.Array at or above the size threshold, with the
     plane enabled. Cheap for non-array values (one sys.modules probe + one
-    isinstance) — this runs on every task/actor return."""
+    isinstance) — this runs on every task/actor return. `min_bytes`
+    overrides the general plane's RT_DEVICE_OBJECT_MIN_BYTES threshold
+    (compiled-DAG edges pass RT_DAG_EDGE_MIN_BYTES: pre-negotiated
+    point-to-point edges amortize the pin on much smaller arrays)."""
     jax = sys.modules.get("jax")
     if jax is None:
         # No jax imported in this process => the value can't be a jax.Array.
@@ -157,7 +176,8 @@ def eligible(value) -> bool:
         return False
     try:
         nbytes = int(value.nbytes)
-        if nbytes < CONFIG.device_object_min_bytes:
+        if nbytes < (CONFIG.device_object_min_bytes
+                     if min_bytes is None else min_bytes):
             return False
         if value.is_deleted():
             return False
@@ -266,6 +286,7 @@ def pin_edge(oid: str, value, worker):
     and whose unpickle resolves through the ordinary tier ladder."""
     nbytes = int(value.nbytes)
     _TABLE.pin(oid, value, nbytes)
+    _RESOLVE_STATS["edge_pins"] += 1
     _ensure_metrics_flusher()
     _notify_pins()
     return _DeviceRef(_make_desc(oid, value, nbytes, worker))
@@ -387,6 +408,7 @@ def _resolve(desc: dict):
     oid = desc["oid"]
     arr = _TABLE.get(oid)
     if arr is not None:
+        _RESOLVE_STATS["tier0"] += 1
         return arr  # tier 0: same process, zero-copy, identity-preserving
     from ray_tpu._private.worker import global_worker
 
@@ -396,7 +418,9 @@ def _resolve(desc: dict):
             f"device object {oid[:16]} cannot be resolved: no ray_tpu "
             f"runtime in this process (producer {desc['worker'][:12]})")
     mv = w.store.get(oid)  # a prior resolve / sibling export already local?
-    if mv is None:
+    if mv is not None:
+        _RESOLVE_STATS["store_hit"] += 1
+    else:
         # Tiers 1/2 do real network work (producer export RPC + attach or
         # chunked fetch): span it so a traced consumer's timeline shows
         # where device-object localization time goes. Tier 0 above stays
@@ -430,11 +454,13 @@ def _localize(w, desc: dict):
         if addr[0] == w.server_addr[0]:
             mv = w.store.get(oid)  # tier 1: same host, attach the export
             if mv is not None:
+                _RESOLVE_STATS["export_rpc"] += 1
                 return mv
         if _fetch_via_conn(w, conn, oid,
                            timeout=_op_timeout(120.0)):  # tier 2: pull
             mv = w.store.get(oid)
             if mv is not None:
+                _RESOLVE_STATS["fetch"] += 1
                 return mv
         raise exc.ObjectLostError(
             f"device object {oid[:16]} lost: fetch from producer "

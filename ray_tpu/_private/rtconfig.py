@@ -308,6 +308,24 @@ _flag("dag_monitor_interval_s", float, 0.2)
 # Per-edge shm channel capacity (one in-flight message per edge; a
 # message may be at most this large).
 _flag("dag_channel_bytes", int, 1 << 20)
+# Device-edge eligibility threshold (bytes). DAG edges are pre-negotiated
+# point-to-point with a bounded retention window, so the plane pays for
+# itself on much smaller arrays than the general object plane's
+# RT_DEVICE_OBJECT_MIN_BYTES — a pipeline-parallel decode step's
+# activation is a few KB and must still ride as a placeholder.
+_flag("dag_edge_min_bytes", int, 1024)
+# --- pipeline-parallel serving (README "Pipeline-parallel serving") ---------
+# Stage count for the OpenAI serving surface: >1 builds a PipelinedEngine
+# (model split into this many DAG stage actors) behind the same
+# submit()/GenStream API; 0/1 keeps the single-process ContinuousEngine.
+_flag("pp_stages", int, 0)
+# Microbatch SIZE (slots per microbatch) for the pipelined engine;
+# 0 = auto (max_batch split into 2*n_stages microbatches, enough to keep
+# every stage busy with headroom under RT_DAG_MAX_INFLIGHT).
+_flag("pp_microbatch", int, 0)
+# Consecutive graph-rebuild attempts after stage death before the engine
+# gives up and drains every open stream with the attributed error.
+_flag("pp_rebuild_max", int, 3)
 # --- kernels / diagnostics --------------------------------------------------
 # Decode-attention kernel selection: "pallas" / "xla" force a path, ""
 # keeps the size-based dispatch (ops/decode_attention.py

@@ -296,6 +296,18 @@ class WorkerSampler:
                     eng.tokens_per_s_snapshot(), 2)
             except Exception:
                 pass
+        pp = sys.modules.get("ray_tpu.llm.pipeline")
+        if pp is not None:
+            # Pipeline-stage occupancy (README "Pipeline-parallel
+            # serving"): busy fraction of this process's stage(s) since
+            # the previous tick — the bubble is its complement. Only
+            # processes hosting a PipelineStage import the module.
+            try:
+                occ = pp.occupancy_snapshot("telemetry")
+                if occ:
+                    out["llm.pp_occupancy"] = round(max(occ.values()), 3)
+            except Exception:
+                pass
         return out
 
 

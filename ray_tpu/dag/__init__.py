@@ -166,16 +166,24 @@ def _edge_write(ch: Channel, value, stop: Optional[threading.Event],
 
 class _EdgePublisher:
     """Device-object edge encoder (one per producing node, one for the
-    driver's input edges): large single-device jax.Arrays are pinned
-    locally and replaced by the ~200B tier-ladder placeholder; everything
-    else passes through untouched. Pins retire on the 2-invocation window
-    proved safe by channel backpressure (module docstring)."""
+    driver's input edges): large single-device jax.Arrays — bare or inside
+    a tuple/list stage output (iterative graphs carry (tag, activation,
+    meta) messages) — are pinned locally and replaced by the ~200B
+    tier-ladder placeholder; everything else passes through untouched.
+    Each pinned array is also eagerly EXPORTED into the local shm store at
+    publish time: the export precedes the channel write, so a same-host
+    consumer's resolve is a store hit — zero RPCs in the steady state —
+    instead of an export_device_object round trip back to the producer.
+    Pins retire on the 2-invocation window proved safe by channel
+    backpressure (module docstring); retirement is grouped per publish so
+    multi-array messages keep the same window."""
 
-    __slots__ = ("_pins", "_on")
+    __slots__ = ("_pins", "_on", "_min_bytes")
 
     def __init__(self):
-        self._pins: list[str] = []  # oldest first
+        self._pins: list[list[str]] = []  # oldest first; one group/publish
         self._on: Optional[bool] = None
+        self._min_bytes: Optional[int] = None
 
     def _enabled(self) -> bool:
         on = self._on
@@ -190,9 +198,23 @@ class _EdgePublisher:
     def publish(self, value):
         if not self._enabled():
             return value
+        if self._min_bytes is None:
+            try:
+                self._min_bytes = int(CONFIG.dag_edge_min_bytes)
+            except Exception:
+                self._min_bytes = 1024
+        group: list[str] = []
+        out = self._pub(value, group, depth=0)
+        self._pins.append(group)
+        return out
+
+    def _pub(self, value, group: list, depth: int):
+        if depth < 2 and type(value) in (tuple, list):
+            items = [self._pub(v, group, depth + 1) for v in value]
+            return tuple(items) if type(value) is tuple else items
         from ray_tpu._private import device_store
 
-        if not device_store.eligible(value):
+        if not device_store.eligible(value, min_bytes=self._min_bytes):
             return value
         from ray_tpu._private.worker import global_worker
 
@@ -201,7 +223,15 @@ class _EdgePublisher:
             return value
         oid = random_id_bytes(16).hex()
         ref = device_store.pin_edge(oid, value, w)
-        self._pins.append(oid)
+        if w.store is not None:
+            try:
+                # Eager same-host export: one host copy now (the lazy path
+                # pays the same copy at first consumer RPC) buys every
+                # consumer an RPC-free store-hit resolve.
+                device_store.export_to_store(oid, w.store)
+            except Exception:
+                pass  # consumers fall back to the export-RPC tier
+        group.append(oid)
         return ref
 
     def retire(self, keep: int = 2) -> None:
@@ -213,13 +243,15 @@ class _EdgePublisher:
             self._free(self._pins.pop())
 
     @staticmethod
-    def _free(oid: str) -> None:
+    def _free(oids: list) -> None:
+        if not oids:
+            return
         try:
             from ray_tpu._private import device_store
             from ray_tpu._private.worker import global_worker
 
             w = global_worker()
-            device_store.free_local([oid], store=w.store if w else None)
+            device_store.free_local(oids, store=w.store if w else None)
         except Exception:
             pass  # process-exit frees are the backstop
 

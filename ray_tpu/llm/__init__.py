@@ -143,6 +143,10 @@ def __getattr__(name):
         from ray_tpu.llm import engine as _e
 
         return getattr(_e, name)
+    if name in ("PipelinedEngine", "PipelineStage"):
+        from ray_tpu.llm import pipeline as _p
+
+        return getattr(_p, name)
     if name in ("build_openai_app", "OpenAIServer", "ByteTokenizer"):
         from ray_tpu.llm import openai as _o
 

@@ -233,6 +233,88 @@ class _Slot:
         self.emitted = 0
 
 
+# ------------------------------------------------------- stage slicing
+def model_config(cfg):
+    """LLMConfig -> TransformerConfig, the single place the serving model
+    shape is derived (ContinuousEngine and the pipeline stages must agree
+    bit-for-bit: a pipelined run is the SAME model cut at layer
+    boundaries, so matched-parameter A/B comparisons stay honest)."""
+    import jax.numpy as jnp
+
+    from ray_tpu.models.transformer import TransformerConfig
+
+    return TransformerConfig(
+        vocab_size=cfg.vocab_size, d_model=cfg.d_model,
+        n_layers=cfg.n_layers, n_heads=cfg.n_heads,
+        n_kv_heads=cfg.n_heads, d_ff=int(cfg.d_model * 8 / 3) // 8 * 8,
+        max_seq=cfg.max_seq, dtype=jnp.dtype(cfg.dtype))
+
+
+def stage_layer_split(n_layers: int, n_stages: int) -> list[tuple[int, ...]]:
+    """Contiguous, balanced layer ranges, one per pipeline stage (the
+    remainder layers go to the EARLIEST stages: the last stage already
+    carries final_norm + the tied head + the sampler)."""
+    if not (1 <= n_stages <= n_layers):
+        raise ValueError(
+            f"n_stages ({n_stages}) must be in [1, n_layers ({n_layers})]")
+    base, rem = divmod(n_layers, n_stages)
+    out, start = [], 0
+    for s in range(n_stages):
+        n = base + (1 if s < rem else 0)
+        out.append(tuple(range(start, start + n)))
+        start += n
+    return out
+
+
+def stage_param_slice(params: dict, layers: tuple, first: bool,
+                      last: bool) -> dict:
+    """This stage's shard of a full Transformer param tree. Layer keys keep
+    their GLOBAL names (`layer_{i}`) so a shard is a strict subtree of the
+    full checkpoint; the embedding rides along on the first stage (embed)
+    and the last (tied output head)."""
+    out = {}
+    if first or last:
+        out["tok_emb"] = params["tok_emb"]
+    for i in layers:
+        out[f"layer_{i}"] = params[f"layer_{i}"]
+    if last:
+        out["final_norm"] = params["final_norm"]
+    return out
+
+
+def make_stage_net(mcfg, layers: tuple, first: bool, last: bool):
+    """Flax module computing one pipeline stage's slice of the Transformer:
+    embed (first stage) -> layers[a:b] -> final_norm + tied head (last
+    stage). Per-layer module names match the full model's, so
+    stage_param_slice output applies directly and a 1-stage net is
+    numerically the full Transformer."""
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    from ray_tpu.models.transformer import Block, RMSNorm
+
+    class _StageNet(nn.Module):
+        @nn.compact
+        def __call__(self, x, positions, decode: bool = True):
+            emb = None
+            if first or last:
+                emb = self.param(
+                    "tok_emb", nn.initializers.normal(0.02),
+                    (mcfg.vocab_size, mcfg.d_model), mcfg.param_dtype)
+            if first:
+                x = emb[x].astype(mcfg.dtype)
+            for i in layers:
+                x = Block(mcfg, name=f"layer_{i}")(x, positions,
+                                                   decode=decode)
+            if last:
+                x = RMSNorm(name="final_norm")(x)
+                x = jnp.einsum("bsd,vd->bsv", x,
+                               emb.astype(mcfg.dtype)).astype(jnp.float32)
+            return x
+
+    return _StageNet()
+
+
 class ContinuousEngine:
     """In-flight-batching engine over the flagship Transformer."""
 
@@ -243,18 +325,14 @@ class ContinuousEngine:
         import jax.numpy as jnp
 
         from ray_tpu.llm import LLMConfig  # noqa: F401 (type)
-        from ray_tpu.models.transformer import Transformer, TransformerConfig
+        from ray_tpu.models.transformer import Transformer
 
         self.cfg = cfg
         self.max_batch = max_batch
         self.decode_chunk = decode_chunk
         self.pipeline_depth = max(1, pipeline_depth)
         self.mesh = mesh
-        mcfg = TransformerConfig(
-            vocab_size=cfg.vocab_size, d_model=cfg.d_model,
-            n_layers=cfg.n_layers, n_heads=cfg.n_heads,
-            n_kv_heads=cfg.n_heads, d_ff=int(cfg.d_model * 8 / 3) // 8 * 8,
-            max_seq=cfg.max_seq, dtype=jnp.dtype(cfg.dtype))
+        mcfg = model_config(cfg)
         self.model = Transformer(mcfg)
         if cfg.params is not None:
             params = cfg.params["params"] if "params" in cfg.params else cfg.params

@@ -55,13 +55,29 @@ class OpenAIServer:
 
     def __init__(self, cfg: LLMConfig, model_id: str = "ray-tpu-llm",
                  max_batch: int = 8, decode_chunk: int = 8,
-                 default_max_tokens: int = 64):
+                 default_max_tokens: int = 64,
+                 pipeline_stages: Optional[int] = None):
         self.cfg = cfg
         self.model_id = model_id
         self.default_max_tokens = default_max_tokens
         self.tok = ByteTokenizer()
-        self.engine = ContinuousEngine(
-            cfg, max_batch=max_batch, decode_chunk=decode_chunk)
+        # pipeline_stages > 1 swaps in the pipeline-parallel engine
+        # (README "Pipeline-parallel serving"); None defers to RT_PP_STAGES
+        # so a deployment can be re-pointed without a code change. The two
+        # engines share the submit()/GenStream surface, so every route —
+        # and the serve admission layer above — is engine-agnostic.
+        from ray_tpu._private.rtconfig import CONFIG
+
+        stages = (int(CONFIG.pp_stages) if pipeline_stages is None
+                  else int(pipeline_stages))
+        if stages > 1:
+            from ray_tpu.llm.pipeline import PipelinedEngine
+
+            self.engine = PipelinedEngine(
+                cfg, n_stages=stages, max_batch=max_batch)
+        else:
+            self.engine = ContinuousEngine(
+                cfg, max_batch=max_batch, decode_chunk=decode_chunk)
 
     # ------------------------------------------------------------ helpers
     def _encode_prompt(self, body: dict) -> list[int]:
@@ -101,8 +117,12 @@ class OpenAIServer:
         if path.endswith("/v1/stats") or path.endswith("/stats"):
             # Introspection for chaos tests / ops: which process hosts the
             # engine and how many slots are live (a leaked slot shows here).
-            return {"pid": os.getpid(), "active": self.engine.num_active,
-                    "running": self.engine._running}
+            out = {"pid": os.getpid(), "active": self.engine.num_active,
+                   "running": self.engine._running}
+            stages = getattr(self.engine, "n_stages", 0)
+            if stages:
+                out["pipeline_stages"] = stages
+            return out
         body = request.json() or {}
         chat = "chat" in path or "messages" in body
         prompt = self._encode_prompt(body)
@@ -158,7 +178,8 @@ def build_openai_app(cfg: LLMConfig, *, name: str = "llm",
                      ray_actor_options: Optional[dict] = None,
                      max_ongoing_requests: int = 16,
                      max_queued_requests: int = -1,
-                     queue_deadline_s: Optional[float] = None):
+                     queue_deadline_s: Optional[float] = None,
+                     pipeline_stages: Optional[int] = None):
     """Serve application exposing the OpenAI surface (reference
     build_openai_app, application_builders.py). The admission budgets
     (README "Overload & admission control") pass straight through to the
@@ -174,4 +195,5 @@ def build_openai_app(cfg: LLMConfig, *, name: str = "llm",
         queue_deadline_s=queue_deadline_s)
     return dep.bind(cfg, model_id=model_id, max_batch=max_batch,
                     decode_chunk=decode_chunk,
-                    default_max_tokens=default_max_tokens)
+                    default_max_tokens=default_max_tokens,
+                    pipeline_stages=pipeline_stages)

@@ -466,7 +466,7 @@ def _top_lines(rep: dict) -> list[str]:
     marked rather than freezing their last values."""
     lines = [f"{'NODE':<10} {'STATE':<8} {'CPU%':>6} {'MEM%':>6} "
              f"{'RSS':>8} {'HBM USED/PEAK':>16} {'COMPILE_S':>10} "
-             f"{'TOK/S':>8} {'TASKS':>6}  WORKERS"]
+             f"{'TOK/S':>8} {'PP%':>5} {'TASKS':>6}  WORKERS"]
     nodes = rep.get("nodes") or {}
     for nid in sorted(nodes):
         n = nodes[nid]
@@ -487,13 +487,19 @@ def _top_lines(rep: dict) -> list[str]:
         have_tok = any("llm.tokens_per_s" in w for w in workers.values())
         tok_s = sum(w.get("llm.tokens_per_s", 0.0)
                     for w in workers.values()) if have_tok else None
+        # Pipeline-stage occupancy (README "Pipeline-parallel serving"):
+        # the node's WORST stage busy fraction — the bubble shows as a low
+        # PP% on the stage everyone else waits for; "-" when no stage here.
+        pp_vals = [w["llm.pp_occupancy"] for w in workers.values()
+                   if "llm.pp_occupancy" in w]
+        pp_occ = min(pp_vals) if pp_vals else None
         if dead:
             # A not-alive node's stale values must not render as live
             # readings; keep the real liveness (SUSPECT nodes are frozen
             # pending rejoin, not lost).
             lines.append(f"{nid[:8]:<10} {state or 'DEAD':<8} {'-':>6} "
                          f"{'-':>6} {'-':>8} {'-':>16} {'-':>10} {'-':>8} "
-                         f"{'-':>6}")
+                         f"{'-':>5} {'-':>6}")
             continue
         hbm = (f"{_fmt_bytes(hbm_used)}/{_fmt_bytes(hbm_peak)}"
                if hbm_used is not None else "-")
@@ -506,6 +512,7 @@ def _top_lines(rep: dict) -> list[str]:
             f"{_fmt_bytes(nd.get('rss')):>8} {hbm:>16} "
             f"{compile_s:>10.2f} "
             f"{(f'{tok_s:.0f}' if tok_s is not None else '-'):>8} "
+            f"{(f'{pp_occ * 100:.0f}' if pp_occ is not None else '-'):>5} "
             f"{int(nd.get('tasks_running', 0)):>6}  {len(workers)}")
     ctrl = rep.get("controller") or {}
     tables = ctrl.get("tables") or {}

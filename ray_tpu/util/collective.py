@@ -191,6 +191,39 @@ def init_collective_group(world_size: int, rank: int, group_name: str = "default
     return g
 
 
+def init_prenegotiated_group(world_size: int, rank: int, addrs: dict,
+                             group_name: str = "default",
+                             connect: bool = False):
+    """Join a group whose full rank->(host, port) address map was gathered
+    ONCE by a coordinator and pushed to every member — the compiled-DAG
+    model applied to collectives: membership is negotiated at compile
+    time, like channels are, so joining does no controller KV publish and
+    no rendezvous polling (init_collective_group's per-rank put + poll).
+    Pipeline/tensor-parallel stages use this: the DAG driver collects each
+    stage worker's RPC address at build time and every stage joins with
+    one local call. `connect=True` additionally dials every peer now, so
+    first-op latency (and the device-object plane's preference for
+    established group links, device_store._collective_conn) doesn't wait
+    on a lazy connect."""
+    w = _worker()
+    w.collective_msg_cb = _inbox_deliver
+    with _inbox_cv:
+        for k in [k for k in _inboxes if k[0] == group_name]:
+            del _inboxes[k]
+    amap = {int(r): tuple(a) for r, a in addrs.items()}
+    if len(amap) != world_size or sorted(amap) != list(range(world_size)):
+        raise ValueError(
+            f"pre-negotiated group {group_name!r}: address map must cover "
+            f"ranks 0..{world_size - 1} exactly (got {sorted(amap)})")
+    g = _manager.create(group_name, world_size, rank)
+    g.addrs = amap
+    if connect:
+        for r in range(world_size):
+            if r != rank:
+                _conn_to(g, r)
+    return g
+
+
 def destroy_collective_group(group_name: str = "default"):
     _manager.destroy(group_name)
 

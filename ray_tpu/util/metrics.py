@@ -44,6 +44,7 @@ def _flush_now(force: bool = False):
 
     _drain_task_dispatch()
     _drain_device_objects()
+    _drain_pipeline_occupancy()
     # Tracing spans piggyback on the metrics flush batches (README "Tracing
     # & timeline"): one push per tick carries both — no extra connection,
     # cadence, or frame. sys.modules gate: a process that never traced must
@@ -209,6 +210,24 @@ def _drain_device_objects() -> None:
     DEVICE_OBJECTS_BYTES.set(stats["bytes"], tags=tags)
 
 
+def _drain_pipeline_occupancy() -> None:
+    """Per-stage pipeline occupancy/bubble gauges, one sample per flush
+    window. sys.modules gate: only processes hosting a PipelineStage ever
+    import llm.pipeline, so everyone else skips the drain entirely."""
+    import sys
+
+    pp = sys.modules.get("ray_tpu.llm.pipeline")
+    if pp is None:
+        return
+    try:
+        occ = pp.occupancy_snapshot("metrics")
+    except Exception:
+        return
+    for stage, frac in occ.items():
+        LLM_PP_OCCUPANCY.set(frac, tags={"stage": stage})
+        LLM_PP_BUBBLE.set(max(0.0, 1.0 - frac), tags={"stage": stage})
+
+
 class Metric:
     def __init__(self, name: str, description: str = "",
                  tag_keys: Optional[Sequence[str]] = None):
@@ -363,6 +382,21 @@ DECODE_STEP_SECONDS = Histogram(
     "rt_decode_step_seconds",
     description="llm engine host-sync readback duration per decode drain",
     boundaries=[0.0005, 0.002, 0.01, 0.05, 0.2, 1.0, 5.0])
+
+#: Pipeline-parallel serving (README "Pipeline-parallel serving"), drained
+#: each flush tick in processes hosting a PipelineStage: occupancy is the
+#: stage's busy fraction of the tick window, bubble its complement. A
+#: persistently low-occupancy stage is the pipeline's bubble source —
+#: rebalance the layer split or raise the microbatch count.
+LLM_PP_OCCUPANCY = Gauge(
+    "rt_llm_pp_occupancy",
+    description="pipeline stage busy fraction over the last flush window",
+    tag_keys=("stage",))
+LLM_PP_BUBBLE = Gauge(
+    "rt_llm_pp_bubble",
+    description="pipeline stage idle (bubble) fraction over the last "
+                "flush window",
+    tag_keys=("stage",))
 
 #: Stall escalations are aggregated controller-side from StallReports
 #: (`rt_stalls_total{stage=warn|dump|kill}` — see controller._p_stall_report);

@@ -144,6 +144,33 @@ def test_bench_smoke_runs():
         f"end-to-end streaming decode is {s_ratio}x of the isolated "
         f"engine ({e2e} vs {iso} tok/s medians) — the serving path is "
         f"eating throughput again (gate bound {s_bound}x)")
+    # Pipeline-parallel decode (ISSUE 18 acceptance): the 2-stage
+    # PipelinedEngine vs the single-process engine at matched total
+    # parameters. The throughput bound is core-aware (the bench derives
+    # it: 1.3x where both stages have cores, a sanity floor on 1-core
+    # boxes that time-slice the stage processes), and the zero-RPC
+    # steady state is unconditional: over the measured window the stage
+    # resolve counters must show placeholder pins flowing on activation
+    # edges and ZERO export/fetch RPCs (README "Pipeline-parallel
+    # serving").
+    p_single = rep["details"].get("llm_pipeline_single_tok_s")
+    p_pipe = rep["details"].get("llm_pipeline_tok_s")
+    assert p_single and p_pipe, (
+        "llm_pipeline_decode lane missing (bench skipped it: see its "
+        "stderr)")
+    p_ratio = rep["details"]["llm_pipeline_ratio"]
+    p_bound = rep["details"]["llm_pipeline_bound"]
+    assert p_ratio >= p_bound, (
+        f"pipeline decode is {p_ratio}x of single-process ({p_pipe} vs "
+        f"{p_single} tok/s medians) — below the core-aware gate bound "
+        f"({p_bound}x)")
+    assert rep["details"]["llm_pipeline_edge_pins"] > 0, (
+        "no placeholder pins on activation edges — activations are "
+        "riding the channels inline, not the device-object plane")
+    assert rep["details"]["llm_pipeline_resolve_rpcs"] == 0, (
+        f"{rep['details']['llm_pipeline_resolve_rpcs']} resolve RPCs in "
+        f"the steady-state decode window — the zero-RPC contract is "
+        f"broken")
     # Admission control A/B (ISSUE 17 acceptance): the armed-but-not-
     # binding admission plane must cost nothing on the handle path vs
     # RT_SERVE_ADMISSION=0 (median-of-interleaved-pairs ratio, noise-
