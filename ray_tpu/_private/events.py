@@ -126,6 +126,12 @@ KINDS: dict[str, tuple[str, str]] = {
                                  "invocation failed with DagStageError"),
     "dag_teardown": ("info", "a compiled DAG tore down; all stage loops "
                              "stopped and every channel was unlinked"),
+    # --- data plane exchanges (driver-emitted) -----------------------------
+    "data_exchange": ("info", "an all-to-all exchange (shuffle/sort/"
+                              "repartition) completed; attrs carry map/"
+                              "partition counts and spilled bytes"),
+    "data_spill": ("warning", "an exchange spilled shards through the "
+                              "storage plane under memory pressure"),
     # --- jobs (controller-emitted) -----------------------------------------
     "job_start": ("info", "a job driver subprocess was launched"),
     "job_stop": ("info", "a job reached a terminal state"),

@@ -327,6 +327,32 @@ _flag("pp_microbatch", int, 0)
 # gives up and drains every open stream with the attributed error.
 _flag("pp_rebuild_max", int, 3)
 # --- kernels / diagnostics --------------------------------------------------
+# --- data plane (README "Data plane") ---------------------------------------
+# Pipelined all-to-all exchange: map tasks push partition shards the moment
+# they're produced and reduce-side merges start on first input (bounded
+# fan-in). False restores the barrier exchange (all maps complete before any
+# reduce submits) — kept as the bench A/B leg and an escape hatch.
+_flag("data_pipelined_exchange", bool, True)
+# Per-operator in-flight budget: at most this many block tasks are
+# outstanding per executor stage (submission also brakes on the cluster
+# store-backpressure signal, STORE_BACKPRESSURE_FRACTION).
+_flag("data_max_inflight_blocks", int, 16)
+# Reduce-side fan-in bound: when a partition has accumulated this many
+# pending shards mid-exchange, they are consolidated by an incremental
+# merge task — no reduce ever takes an unbounded argument list.
+_flag("data_reduce_fanin", int, 8)
+# Target bytes per block for file reads: small files group toward this
+# size, files larger than it split into row-sliced read tasks, so the
+# exchange has real parallelism regardless of the on-disk file layout.
+_flag("data_block_bytes", int, 128 * 1024 * 1024)
+# Exchange shard memory cap (bytes): a consolidated partition shard larger
+# than this spills through the storage plane instead of staying in shm
+# (0 disables size-triggered spill; store backpressure still forces it).
+_flag("data_mem_cap_bytes", int, 0)
+# Storage-plane URI exchange shards spill under (any backend: local://,
+# mem://, sim://); "" = local://<session_dir>/data_spill. Spilled shards
+# are restored transparently when the reduce consumes them.
+_flag("data_spill_uri", str, "")
 # Decode-attention kernel selection: "pallas" / "xla" force a path, ""
 # keeps the size-based dispatch (ops/decode_attention.py
 # PALLAS_MIN_CACHE_BYTES).

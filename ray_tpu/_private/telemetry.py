@@ -296,6 +296,19 @@ class WorkerSampler:
                     eng.tokens_per_s_snapshot(), 2)
             except Exception:
                 pass
+        xch = sys.modules.get("ray_tpu.data._internal.exchange")
+        if xch is not None:
+            # Exchange pressure (README "Data plane"): blocks in flight,
+            # bytes spilled through the storage plane, and submit-loop
+            # backpressure stalls. The module only loads in processes that
+            # drive or execute an exchange.
+            try:
+                st = xch.exchange_stats()
+                out["data.blocks_inflight"] = st["blocks_inflight"]
+                out["data.spilled_bytes"] = st["spilled_bytes"]
+                out["data.bp_stalls"] = st["bp_stalls"]
+            except Exception:
+                pass
         pp = sys.modules.get("ray_tpu.llm.pipeline")
         if pp is not None:
             # Pipeline-stage occupancy (README "Pipeline-parallel

@@ -5,12 +5,14 @@ streaming_executor.py:52 (pull-based streaming over an operator DAG with
 bounded in-flight work) + operators/map_operator.py:64 (task-based map) +
 logical/optimizers.py (operator fusion).
 
-v0 design: logical ops are fused into per-block transform chains
+Design: logical ops are fused into per-block transform chains
 (reference's MapOperator fusion), executed as remote tasks with a bounded
 in-flight window so a long dataset streams instead of materializing; blocks
 live in the object store between stages. All-to-all ops (repartition,
-random_shuffle, sort) are barriers, like the reference's
-AllToAllOperator/exchange.
+random_shuffle, sort) ride the pipelined map/reduce exchange in
+exchange.py (reduce-side merging overlaps the map wave — no stage
+barrier); streaming consumption lives in streaming.py. This module owns
+the logical plan, fusion, and the per-block map path.
 """
 
 from __future__ import annotations
@@ -20,12 +22,8 @@ import time
 from typing import Any, Callable, Optional
 
 import ray_tpu
+from ray_tpu.data._internal import exchange as _ex
 from ray_tpu.data.block import BlockAccessor, combine_blocks
-
-# Bounded concurrent block tasks + a resource-based brake (reference
-# backpressure_policy/: ConcurrencyCapBackpressurePolicy and the
-# object-store-memory policy in streaming_executor_state).
-MAX_IN_FLIGHT = 16
 #: Pause new block submissions while cluster shm usage is above this
 #: fraction of capacity (consumers/spill catch up; submissions resume).
 STORE_BACKPRESSURE_FRACTION = 0.75
@@ -231,11 +229,6 @@ def _split_block(block, sizes):
     return out if len(out) > 1 else out[0]
 
 
-@ray_tpu.remote
-def _merge_blocks(*blocks):
-    return combine_blocks(list(blocks))
-
-
 def _key_fn(key):
     return key if callable(key) else (
         lambda r, k=key: r[k] if isinstance(r, dict) else r)
@@ -247,10 +240,11 @@ def _sort_block_local(block, key, descending):
     return sorted(rows, key=_key_fn(key), reverse=descending)
 
 
-# ---- distributed exchange tasks (reference planner/exchange/
-# sort_task_spec.py + shuffle_task_spec.py: sample -> range-partitioned map
-# tasks -> merge reduce tasks; the driver touches only sampled keys and
-# refs, never rows) --------------------------------------------------------
+# ---- distributed exchange map tasks (reference planner/exchange/
+# sort_task_spec.py + shuffle_task_spec.py map sides; the reduce side —
+# consolidation + finalize — lives in exchange.py). Every shard is tagged
+# (map_idx, payload) so exchange merges are arrival-order independent; the
+# driver touches only sampled keys and refs, never rows. ------------------
 @ray_tpu.remote
 def _sample_block_keys(block, key, n_samples):
     """Uniform key sample of one block (reference SortTaskSpec.sample)."""
@@ -264,7 +258,7 @@ def _sample_block_keys(block, key, n_samples):
 
 
 @ray_tpu.remote
-def _sort_map(block, key, descending, boundaries):
+def _sort_map(block, map_idx, key, descending, boundaries):
     """Map side of the sort exchange: bucket rows by ASCENDING range
     boundaries, each bucket sorted in final order; one return per range
     (reference sort_task_spec.map)."""
@@ -279,20 +273,12 @@ def _sort_map(block, key, descending, boundaries):
         b.sort(key=kf, reverse=descending)
     if descending:
         buckets.reverse()  # partition 0 holds the LARGEST keys
-    return buckets if len(buckets) > 1 else buckets[0]
+    tagged = [(map_idx, b) for b in buckets]
+    return tagged if len(tagged) > 1 else tagged[0]
 
 
 @ray_tpu.remote
-def _sort_reduce(key, descending, *parts):
-    """Reduce side: merge N pre-sorted sub-blocks of one key range
-    (reference sort_task_spec.reduce — heap merge, never a full re-sort)."""
-    import heapq
-
-    return list(heapq.merge(*parts, key=_key_fn(key), reverse=descending))
-
-
-@ray_tpu.remote
-def _shuffle_map(block, k, seed):
+def _shuffle_map(block, map_idx, k, seed):
     """Map side of the shuffle exchange: permute this block's rows and deal
     them into k sub-blocks (reference shuffle_task_spec.map)."""
     rows = BlockAccessor.for_block(block).to_rows()
@@ -303,20 +289,23 @@ def _shuffle_map(block, k, seed):
     parts, off = [], 0
     for i in range(k):
         take = per + (1 if i < extra else 0)
-        parts.append(rows[off:off + take])
+        parts.append((map_idx, rows[off:off + take]))
         off += take
     return parts if k > 1 else parts[0]
 
 
 @ray_tpu.remote
-def _shuffle_reduce(seed, *parts):
-    """Reduce side: concatenate one sub-block from every map task and
-    re-permute (reference shuffle_task_spec.reduce)."""
-    rows = []
-    for p in parts:
-        rows.extend(p)
-    random.Random(seed).shuffle(rows)
-    return rows
+def _repart_map(block, map_idx, k, parts):
+    """Map side of the repartition exchange: cut this block's driver-planned
+    row ranges into format-preserving slices, one return per output
+    partition (empty slice where none of this block lands)."""
+    acc = BlockAccessor.for_block(block)
+    out: list = [(map_idx, [])] * k
+    off = 0
+    for pi, take in parts:
+        out[pi] = (map_idx, acc.slice(off, off + take))
+        off += take
+    return out if k > 1 else out[0]
 
 
 # -------------------------------------------------------------- execution
@@ -347,12 +336,16 @@ def _fuse(plan: list) -> list:
 
 def _windowed_submit(items: list, submit) -> list:
     """Submit one task per item with a bounded in-flight window (streaming
-    — reference streaming_executor's bounded operator concurrency)."""
+    — reference streaming_executor's bounded operator concurrency). The
+    window is the per-operator block budget (RT_DATA_MAX_INFLIGHT_BLOCKS)
+    plus the store-backpressure brake (reference backpressure_policy/:
+    ConcurrencyCapBackpressurePolicy + the object-store-memory policy)."""
+    budget = _ex.inflight_budget()
     out = [None] * len(items)
     in_flight: dict = {}
     i = 0
     while i < len(items) or in_flight:
-        while (i < len(items) and len(in_flight) < MAX_IN_FLIGHT
+        while (i < len(items) and len(in_flight) < budget
                and not (in_flight and _store_backpressured())):
             # The brake only engages with work already in flight: progress
             # is always possible even when the store starts above the mark.
@@ -503,8 +496,17 @@ def _block_sizes(refs: list) -> list[int]:
 
 
 def _repartition(refs: list, k: int) -> list:
-    """Exchange: split every block into k parts, merge part i across blocks
-    (reference planner/exchange/)."""
+    return list(_repartition_stream(refs, k))
+
+
+def _repartition_stream(refs: list, k: int):
+    """Repartition as a pipelined exchange: the driver plans row-range
+    assignments from block COUNTS, map tasks cut format-preserving slices,
+    the exchange's reduce side concatenates each output partition
+    (reference planner/exchange/). Rows never visit the driver; returns an
+    iterator of partition refs (streaming.py consumes it lazily)."""
+    if not refs:
+        return iter(())
     sizes = _block_sizes(refs)
     total = sum(sizes)
     target = [total // k + (1 if i < total % k else 0) for i in range(k)]
@@ -523,65 +525,49 @@ def _repartition(refs: list, k: int) -> list:
                 t_i += 1
                 t_left = target[t_i]
         splits_per_block.append(parts)
-    pieces: dict[int, list] = {i: [] for i in range(k)}
-    for ref, parts in zip(refs, splits_per_block):
-        if len(parts) == 1:
-            pieces[parts[0][0]].append(ref)
-            continue
-        # Multi-return split: piece refs only — payloads never visit the
-        # driver (reference exchange tasks are fully distributed too).
-        prefs = _split_block.options(num_returns=len(parts)).remote(
-            ref, [p[1] for p in parts])
-        if not isinstance(prefs, list):
-            prefs = [prefs]
-        for (pi, _), pref in zip(parts, prefs):
-            pieces[pi].append(pref)
-    return [_merge_blocks.remote(*pieces[i]) if len(pieces[i]) != 1 else pieces[i][0]
-            for i in range(k) if pieces[i]]
-
-
-def _exchange_maps(refs: list, submit_one, k: int) -> list[list]:
-    """Run map-side exchange tasks (k returns each) with the bounded
-    in-flight window; returns per-partition lists of sub-block refs. The
-    driver handles ONLY refs. submit_one receives (block_index, ref)."""
-    def _submit(pair):
-        prefs = submit_one(*pair)
-        return prefs if isinstance(prefs, list) else [prefs]
-
-    all_parts = _windowed_submit(list(enumerate(refs)), _submit)
-    return [[parts[i] for parts in all_parts] for i in range(k)]
+    stream = _ex.exchange_partitions(
+        refs, op="concat", k=k,
+        map_submit=lambda i, r: _repart_map.options(num_returns=k).remote(
+            r, i, k, splits_per_block[i]))
+    # Empty output partitions (fewer rows than k) are dropped, matching
+    # Dataset.num_blocks() semantics for tiny datasets.
+    return (b for b, t in zip(stream, target) if t)
 
 
 def _random_shuffle(refs: list, seed) -> list:
+    return list(_random_shuffle_stream(refs, seed))
+
+
+def _random_shuffle_stream(refs: list, seed):
     """Distributed shuffle exchange (reference shuffle_task_spec.py): map
-    tasks permute + deal each block into k sub-blocks, reduce tasks merge
-    one sub-block per map and re-permute. Rows never visit the driver."""
+    tasks permute + deal each block into k sub-blocks, the exchange's
+    reduce side merges one sub-block per map and re-permutes. Rows never
+    visit the driver."""
     if not refs:
-        return refs
+        return iter(())
     k = len(refs)
     base = seed if seed is not None else random.randrange(1 << 30)
-    by_part = _exchange_maps(
-        refs,
-        lambda i, r: _shuffle_map.options(num_returns=k).remote(
-            r, k, base ^ (0x9E3779B9 * (i + 1))),
-        k)
-    return _windowed_submit(
-        list(range(k)),
-        lambda i: _shuffle_reduce.remote(base ^ (0x85EBCA6B * (i + 1)),
-                                         *by_part[i]))
+    return _ex.exchange_partitions(
+        refs, op="shuffle", k=k,
+        map_submit=lambda i, r: _shuffle_map.options(num_returns=k).remote(
+            r, i, k, base ^ (0x9E3779B9 * (i + 1))),
+        finalize_arg=lambda p: base ^ (0x85EBCA6B * (p + 1)))
 
 
 def _global_sort(refs: list, key, descending) -> list:
+    return list(_global_sort_stream(refs, key, descending))
+
+
+def _global_sort_stream(refs: list, key, descending):
     """Distributed sort exchange (reference sort_task_spec.py): sample keys
     -> compute k-1 range boundaries -> map tasks range-partition + locally
-    sort -> reduce tasks heap-merge each range. The driver sees sampled
-    KEYS only, never rows — the previous implementation heap-merged every
-    block on the driver and could not scale past driver memory."""
+    sort -> the exchange's reduce side heap-merges each range. The driver
+    sees sampled KEYS only, never rows."""
     if not refs:
-        return refs
+        return iter(())
     k = len(refs)
     if k == 1:
-        return [_sort_block_local.remote(refs[0], key, descending)]
+        return iter([_sort_block_local.remote(refs[0], key, descending)])
     # 1. sample (driver holds ~20 keys per block, not rows)
     samples_per_block = 20
     key_samples: list = []
@@ -591,22 +577,19 @@ def _global_sort(refs: list, key, descending) -> list:
         key_samples.extend(ray_tpu.get(sref, timeout=600))
     key_samples.sort()
     if not key_samples:
-        return refs
+        return iter(refs)
     # 2. boundaries: k-1 ascending quantile cut points
     boundaries = [key_samples[min(len(key_samples) - 1,
                                   (len(key_samples) * (i + 1)) // k)]
                   for i in range(k - 1)]
-    # 3. map: range-partition + sort each block
-    by_part = _exchange_maps(
-        refs,
-        lambda _i, r: _sort_map.options(num_returns=k).remote(
-            r, key, descending, boundaries),
-        k)
-    # 4. reduce: merge each range (partition order already matches
-    # `descending` — _sort_map reverses bucket order for descending)
-    return _windowed_submit(
-        list(range(k)),
-        lambda i: _sort_reduce.remote(key, descending, *by_part[i]))
+    # 3+4. map (range-partition + local sort) feeding the pipelined merge;
+    # partition order already matches `descending` — _sort_map reverses
+    # bucket order for descending.
+    return _ex.exchange_partitions(
+        refs, op="sort", k=k,
+        map_submit=lambda i, r: _sort_map.options(num_returns=k).remote(
+            r, i, key, descending, boundaries),
+        finalize_arg=(key, descending))
 
 
 def _limit(refs: list, n: int) -> list:

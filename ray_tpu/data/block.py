@@ -12,6 +12,18 @@ from typing import Any, Iterable
 import numpy as np
 
 
+def _column(values) -> np.ndarray:
+    """Build a numpy column from row values. bytes/str rows must get
+    object dtype: numpy's fixed-width S/U dtypes treat trailing NULs as
+    padding and silently strip them on element access."""
+    if isinstance(values, np.ndarray):
+        return values
+    vals = values if isinstance(values, list) else list(values)
+    if vals and isinstance(vals[0], (bytes, bytearray, str)):
+        return np.asarray(vals, dtype=object)
+    return np.asarray(vals)
+
+
 class BlockAccessor:
     def __init__(self, block):
         self.block = block
@@ -44,14 +56,14 @@ class BlockAccessor:
     def to_batch(self) -> dict:
         """Column dict of numpy arrays."""
         if self.is_columnar():
-            return {k: np.asarray(v) for k, v in self.block.items()}
+            return {k: _column(v) for k, v in self.block.items()}
         if not self.block:
             return {}
         first = self.block[0]
         if isinstance(first, dict):
             cols = list(first.keys())
-            return {c: np.asarray([r[c] for r in self.block]) for c in cols}
-        return {"item": np.asarray(self.block)}
+            return {c: _column([r[c] for r in self.block]) for c in cols}
+        return {"item": _column(self.block)}
 
     def slice(self, start: int, end: int):
         if self.is_columnar():
@@ -72,7 +84,7 @@ def combine_blocks(blocks: list) -> Any:
         return []
     if isinstance(blocks[0], dict):
         keys = blocks[0].keys()
-        return {k: np.concatenate([np.asarray(b[k]) for b in blocks]) for k in keys}
+        return {k: np.concatenate([_column(b[k]) for b in blocks]) for k in keys}
     out = []
     for b in blocks:
         out.extend(b)

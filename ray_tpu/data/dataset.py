@@ -135,9 +135,25 @@ class Dataset:
 
     def iter_batches(self, *, batch_size: int = 256,
                      batch_format: str = "numpy") -> Iterable[dict]:
-        """Stream column-dict batches (reference iter_batches)."""
-        it = DataIterator(self._block_refs())
-        yield from it.iter_batches(batch_size=batch_size, batch_format=batch_format)
+        """Stream column-dict batches (reference iter_batches). An
+        unexecuted plan streams through _internal.streaming: a trailing
+        all-to-all op is consumed block-by-block as its pipelined exchange
+        produces reduce outputs, never materialized driver-side. The block
+        refs are cached only after a full consumption."""
+        if self._cached_refs is not None:
+            it = DataIterator(self._cached_refs)
+            yield from it.iter_batches(batch_size=batch_size,
+                                       batch_format=batch_format)
+            return
+        from ray_tpu.data._internal import streaming
+
+        def _cache(refs):
+            if self._cached_refs is None:
+                self._cached_refs = refs
+
+        yield from streaming.iter_batches(
+            self._plan, batch_size=batch_size, batch_format=batch_format,
+            on_complete=_cache)
 
     def to_numpy(self, column: Optional[str] = None):
         batches = list(self.iter_batches(batch_size=1 << 30))

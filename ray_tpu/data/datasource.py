@@ -41,7 +41,8 @@ class Datasource:
 def _expand_paths(paths) -> list[str]:
     """File path / dir / glob expansion (reference file_based_datasource
     path resolution, local scheme only — cloud storage is out of scope for
-    the single-host object store; spill already covers local disk)."""
+    the single-host object store; exchange spill goes through the
+    `ray_tpu.storage` backend seam, not through datasource paths)."""
     if isinstance(paths, str):
         paths = [paths]
     out: list[str] = []
@@ -61,7 +62,16 @@ def _expand_paths(paths) -> list[str]:
 
 
 class FileBasedDatasource(Datasource):
-    """One read task per file group; subclasses parse a single file."""
+    """One read task per byte-sized file group; subclasses parse a single
+    file. Blocks target RT_DATA_BLOCK_BYTES (reference file_based_
+    datasource's target_max_block_size): many small files pack into one
+    task, one oversized file splits into row-range slices — so the
+    exchange downstream gets real parallelism either way, instead of one
+    block per file."""
+
+    #: Subclasses where a file's rows cannot be sliced (e.g. one row per
+    #: whole file) set this False; oversized files then stay one block.
+    _splittable = True
 
     def __init__(self, paths, **reader_kwargs):
         self._paths = _expand_paths(paths)
@@ -70,30 +80,78 @@ class FileBasedDatasource(Datasource):
     def _read_file(self, path: str):
         raise NotImplementedError
 
-    def _read_group(self, group: list[str]):
-        from ray_tpu.data.block import combine_blocks
+    def _read_group(self, group: list):
+        """group entries: a path (whole file) or a (path, j, m) triplet —
+        slice j of m equal row ranges of one oversized file."""
+        from ray_tpu.data.block import BlockAccessor, combine_blocks
 
-        blocks = [self._read_file(p) for p in group]
+        blocks = []
+        for item in group:
+            if isinstance(item, tuple):
+                path, j, m = item
+                acc = BlockAccessor.for_block(self._read_file(path))
+                n = acc.num_rows()
+                blocks.append(acc.slice((n * j) // m, (n * (j + 1)) // m))
+            else:
+                blocks.append(self._read_file(item))
         return blocks[0] if len(blocks) == 1 else combine_blocks(blocks)
 
+    def estimate_inmemory_data_size(self) -> Optional[int]:
+        try:
+            return sum(os.path.getsize(p) for p in self._paths)
+        except OSError:
+            return None
+
     def get_read_tasks(self, parallelism: int) -> list[ReadTask]:
-        n = max(1, min(parallelism, len(self._paths)))
-        # Contiguous chunks of the sorted path list: block order == file
-        # order, like the reference's contiguous read-task assignment.
-        base, extra = divmod(len(self._paths), n)
-        groups, start = [], 0
-        for i in range(n):
-            count = base + (1 if i < extra else 0)
-            if count:
-                groups.append(self._paths[start:start + count])
-                start += count
+        from ray_tpu._private.rtconfig import CONFIG
+
+        try:
+            sizes = [os.path.getsize(p) for p in self._paths]
+        except OSError:
+            sizes = [0] * len(self._paths)
+        total = sum(sizes)
+        if total <= 0:
+            # No size information: fall back to count-based contiguous
+            # chunks, one group per unit of parallelism.
+            n = max(1, min(parallelism, len(self._paths)))
+            base, extra = divmod(len(self._paths), n)
+            groups, start = [], 0
+            for i in range(n):
+                count = base + (1 if i < extra else 0)
+                if count:
+                    groups.append(self._paths[start:start + count])
+                    start += count
+        else:
+            # Target bytes per block: RT_DATA_BLOCK_BYTES capped so the
+            # requested parallelism is still reachable when the data is
+            # small. Contiguous packing keeps block order == file order.
+            target = max(1, min(max(1, CONFIG.data_block_bytes),
+                                total // max(1, parallelism) or total))
+            groups = []
+            cur: list = []
+            cur_bytes = 0
+            for path, size in zip(self._paths, sizes):
+                if self._splittable and size > target:
+                    if cur:
+                        groups.append(cur)
+                        cur, cur_bytes = [], 0
+                    m = -(-size // target)  # ceil: slices per big file
+                    groups.extend([(path, j, m)] for j in range(m))
+                    continue
+                if cur and cur_bytes + size > target:
+                    groups.append(cur)
+                    cur, cur_bytes = [], 0
+                cur.append(path)
+                cur_bytes += size
+            if cur:
+                groups.append(cur)
         return [ReadTask(_BoundGroupRead(self, g), {"paths": g}) for g in groups]
 
 
 class _BoundGroupRead:
     """Picklable (datasource, group) closure for a read task."""
 
-    def __init__(self, ds: FileBasedDatasource, group: list[str]):
+    def __init__(self, ds: FileBasedDatasource, group: list):
         self.ds = ds
         self.group = group
 
@@ -144,6 +202,8 @@ class TextDatasource(FileBasedDatasource):
 
 
 class BinaryDatasource(FileBasedDatasource):
+    _splittable = False  # one row per whole file: no row ranges to cut
+
     def _read_group(self, group: list[str]):
         data, paths = [], []
         for p in group:

@@ -156,6 +156,34 @@ class DagStageError(RayTpuError):
                  self.traceback_str))
 
 
+def _rebuild_data_spill_error(message, uri, partition, op):
+    return DataSpillError(message, uri=uri, partition=partition, op=op)
+
+
+class DataSpillError(RayTpuError):
+    """An exchange shard could not be spilled to — or restored from — the
+    storage plane (README "Data plane").
+
+    Raised from the exchange's merge/reduce tasks after the bounded
+    transient-retry budget is exhausted (e.g. a severed `sim://` spill
+    backend): the shuffle fails attributed, never hangs. `uri` names the
+    shard that failed, `partition` the reduce partition it belonged to,
+    `op` whether the failure was on the `spill` (write) or `restore`
+    (read) side.
+    """
+
+    def __init__(self, message: str, *, uri: str | None = None,
+                 partition: int | None = None, op: str | None = None):
+        self.uri = uri
+        self.partition = partition
+        self.op = op
+        super().__init__(message)
+
+    def __reduce__(self):
+        return (_rebuild_data_spill_error,
+                (str(self), self.uri, self.partition, self.op))
+
+
 class RuntimeEnvSetupError(RayTpuError):
     """Setting up the runtime environment for a task/actor failed."""
 

@@ -466,7 +466,8 @@ def _top_lines(rep: dict) -> list[str]:
     marked rather than freezing their last values."""
     lines = [f"{'NODE':<10} {'STATE':<8} {'CPU%':>6} {'MEM%':>6} "
              f"{'RSS':>8} {'HBM USED/PEAK':>16} {'COMPILE_S':>10} "
-             f"{'TOK/S':>8} {'PP%':>5} {'TASKS':>6}  WORKERS"]
+             f"{'TOK/S':>8} {'PP%':>5} {'DATA IF/SPILL':>14} "
+             f"{'TASKS':>6}  WORKERS"]
     nodes = rep.get("nodes") or {}
     for nid in sorted(nodes):
         n = nodes[nid]
@@ -493,13 +494,22 @@ def _top_lines(rep: dict) -> list[str]:
         pp_vals = [w["llm.pp_occupancy"] for w in workers.values()
                    if "llm.pp_occupancy" in w]
         pp_occ = min(pp_vals) if pp_vals else None
+        # Data-plane exchange pressure (README "Data plane"): blocks in
+        # flight + spilled bytes summed over the node's exchange-driving
+        # workers; "-" when no exchange ran here.
+        have_data = any("data.blocks_inflight" in w
+                        for w in workers.values())
+        data_if = sum(w.get("data.blocks_inflight", 0)
+                      for w in workers.values()) if have_data else None
+        data_spill = sum(w.get("data.spilled_bytes", 0)
+                         for w in workers.values()) if have_data else None
         if dead:
             # A not-alive node's stale values must not render as live
             # readings; keep the real liveness (SUSPECT nodes are frozen
             # pending rejoin, not lost).
             lines.append(f"{nid[:8]:<10} {state or 'DEAD':<8} {'-':>6} "
                          f"{'-':>6} {'-':>8} {'-':>16} {'-':>10} {'-':>8} "
-                         f"{'-':>5} {'-':>6}")
+                         f"{'-':>5} {'-':>14} {'-':>6}")
             continue
         hbm = (f"{_fmt_bytes(hbm_used)}/{_fmt_bytes(hbm_peak)}"
                if hbm_used is not None else "-")
@@ -513,6 +523,7 @@ def _top_lines(rep: dict) -> list[str]:
             f"{compile_s:>10.2f} "
             f"{(f'{tok_s:.0f}' if tok_s is not None else '-'):>8} "
             f"{(f'{pp_occ * 100:.0f}' if pp_occ is not None else '-'):>5} "
+            f"{(f'{data_if}/{_fmt_bytes(data_spill)}' if data_if is not None else '-'):>14} "
             f"{int(nd.get('tasks_running', 0)):>6}  {len(workers)}")
     ctrl = rep.get("controller") or {}
     tables = ctrl.get("tables") or {}

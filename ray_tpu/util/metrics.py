@@ -45,6 +45,7 @@ def _flush_now(force: bool = False):
     _drain_task_dispatch()
     _drain_device_objects()
     _drain_pipeline_occupancy()
+    _drain_data_exchange()
     # Tracing spans piggyback on the metrics flush batches (README "Tracing
     # & timeline"): one push per tick carries both — no extra connection,
     # cadence, or frame. sys.modules gate: a process that never traced must
@@ -183,8 +184,9 @@ def reset_device_stats_cache() -> None:
     previous session's final report — and histogram bucket boundaries
     (registered once per session via `histogram_decl` records) must be
     re-declared to the fresh controller."""
-    global _last_device_stats
+    global _last_device_stats, _last_data_stats
     _last_device_stats = None
+    _last_data_stats = None
     _hist_declared.clear()
 
 
@@ -208,6 +210,35 @@ def _drain_device_objects() -> None:
     tags = {"worker_id": (w.worker_id[:12] if w is not None else "")}
     DEVICE_OBJECTS_COUNT.set(stats["count"], tags=tags)
     DEVICE_OBJECTS_BYTES.set(stats["bytes"], tags=tags)
+
+
+_last_data_stats: dict | None = None
+
+
+def _drain_data_exchange() -> None:
+    """Data-plane exchange gauges/counters, one sample per flush window.
+    sys.modules gate: only processes that drove or executed an exchange
+    ever import data._internal.exchange."""
+    global _last_data_stats
+    import sys
+
+    xch = sys.modules.get("ray_tpu.data._internal.exchange")
+    if xch is None:
+        return
+    try:
+        stats = xch.exchange_stats()
+    except Exception:
+        return
+    if stats == _last_data_stats:
+        return  # last-value-wins gauges: a flat re-report is noise
+    prev = _last_data_stats or {}
+    _last_data_stats = stats
+    DATA_BLOCKS_INFLIGHT.set(stats["blocks_inflight"])
+    for key, metric in (("spilled_bytes", DATA_SPILLED_BYTES),
+                        ("bp_stalls", DATA_BP_STALLS)):
+        delta = stats[key] - prev.get(key, 0)
+        if delta > 0:
+            metric.inc(delta)
 
 
 def _drain_pipeline_occupancy() -> None:
@@ -328,6 +359,22 @@ DEVICE_OBJECTS_BYTES = Gauge(
     "rt_device_objects_bytes",
     description="bytes pinned in this worker's device object table",
     tag_keys=("worker_id",))
+
+#: Data-plane exchange pressure (see _drain_data_exchange, README "Data
+#: plane"): blocks in flight is the live map-wave width (bounded by
+#: RT_DATA_MAX_INFLIGHT_BLOCKS); spilled bytes counts shards pushed through
+#: the storage plane under memory pressure; stalls counts submit-loop
+#: pauses on store backpressure. Spills/stalls at nominal load mean the
+#: in-flight budget is too wide for the store.
+DATA_BLOCKS_INFLIGHT = Gauge(
+    "rt_data_blocks_inflight",
+    description="exchange block tasks currently in flight")
+DATA_SPILLED_BYTES = Counter(
+    "rt_data_spilled_bytes_total",
+    description="exchange shard bytes spilled through the storage plane")
+DATA_BP_STALLS = Counter(
+    "rt_data_bp_stalls_total",
+    description="exchange submit-loop stalls on store backpressure")
 
 #: Checkpoint engine (README "Checkpointing & storage"), minted at each
 #: manifest commit by train/checkpoint.py. save_seconds is snapshot->commit

@@ -284,3 +284,195 @@ def test_distributed_shuffle_exchange(ray_start_2cpu):
     finally:
         ex.ray_tpu.get = real_get
     assert not seen, f"driver pulled row payloads during shuffle: {seen}"
+
+
+def test_pipelined_reduce_starts_before_last_map(ray_start_2cpu, monkeypatch):
+    """The no-barrier core of the exchange (ISSUE 19 acceptance): with a
+    small reduce fan-in, consolidation tasks must submit while map tasks
+    are still in flight — progress ordering read from exchange_stats()."""
+    from ray_tpu.data._internal import exchange as xch
+
+    monkeypatch.setenv("RT_DATA_REDUCE_FANIN", "2")
+    monkeypatch.setenv("RT_DATA_MAX_INFLIGHT_BLOCKS", "4")
+    xch.reset_exchange_stats()
+    items = [os.urandom(2048) for _ in range(256)]
+    out = rd.from_items(items, parallelism=16).random_shuffle(seed=3).take_all()
+    assert sorted(out) == sorted(items)
+    st = xch.exchange_stats()
+    assert st["maps_done"] == 16
+    assert st["reduces_submitted"] > 16  # consolidations beyond the finals
+    assert st["reduce_before_last_map"] == 1, (
+        "no reduce-side merge submitted while maps were still in flight — "
+        "the exchange ran as a barrier")
+
+
+def test_exchange_spills_under_mem_cap(ray_start_2cpu, monkeypatch, tmp_path):
+    """RT_DATA_MEM_CAP_BYTES forced low: consolidations spill through the
+    storage plane, restore transparently at the final reduce, and the
+    output is still a correct permutation. The driver emits one data_spill
+    event with byte accounting."""
+    from ray_tpu.data._internal import exchange as xch
+    from ray_tpu.util import state
+
+    monkeypatch.setenv("RT_DATA_MEM_CAP_BYTES", "1")
+    monkeypatch.setenv("RT_DATA_REDUCE_FANIN", "2")
+    monkeypatch.setenv("RT_DATA_SPILL_URI", "local://" + str(tmp_path / "sp"))
+    xch.reset_exchange_stats()
+    items = [os.urandom(1024) for _ in range(128)]
+    out = rd.from_items(items, parallelism=8).random_shuffle(seed=9).take_all()
+    assert sorted(out) == sorted(items)
+    # Driver-side accounting: spills are counted once, from the resolved
+    # consolidation metas (a worker-side bump would be invisible here).
+    st = xch.exchange_stats()
+    assert st["spilled_parts"] > 0
+    assert st["spilled_bytes"] > 0
+    import time
+
+    deadline = time.monotonic() + 10
+    evs = state.list_events(kind="data_spill")
+    while not evs and time.monotonic() < deadline:
+        time.sleep(0.1)
+        evs = state.list_events(kind="data_spill")
+    assert evs, "mem-cap-forced spill emitted no data_spill event"
+    assert evs[-1]["attrs"]["bytes"] > 0
+    assert evs[-1]["attrs"]["scheme"] == "local"
+    # Restores self-delete their backing files: the spill dir self-cleans.
+    leftovers = [f for _r, _d, fs in os.walk(str(tmp_path / "sp")) for f in fs]
+    assert leftovers == [], f"spilled shards not cleaned up: {leftovers}"
+
+
+def test_exchange_at_scale_64_blocks(ray_start_2cpu):
+    """64-block shuffle/repartition/sort (ISSUE 19 satellite): permutation
+    and order correctness at a block count where mid-wave consolidation,
+    windowed submission, and per-partition merge ordering all engage."""
+    n = 1024
+    vals = list(range(n))
+    sh = rd.from_items(vals, parallelism=64).random_shuffle(seed=21)
+    out = sh.take_all()
+    assert sorted(out) == vals and out != vals
+    rp = rd.from_items(vals, parallelism=64).repartition(16)
+    assert rp.num_blocks() == 16
+    assert rp.take_all() == vals  # contiguous repartition preserves order
+    so = rd.from_items(vals[::-1], parallelism=64).sort()
+    assert so.take_all() == vals
+
+
+def test_shuffle_per_partition_determinism(ray_start_2cpu):
+    """Fixed seed -> byte-identical output PER BLOCK, not just as a
+    multiset: the map slicing, partition assignment, and per-partition
+    finalize seed are all derived from (seed, index), independent of
+    completion order."""
+    items = [os.urandom(64) for _ in range(512)]
+
+    def blocks(seed):
+        refs = rd.from_items(items, parallelism=16).random_shuffle(
+            seed=seed)._block_refs()
+        return [ray_tpu.get(r, timeout=600) for r in refs]
+
+    a, b = blocks(5), blocks(5)
+    assert a == b
+    assert blocks(6) != a
+
+
+def test_barrier_mode_output_identical(ray_start_2cpu, monkeypatch):
+    """RT_DATA_PIPELINED_EXCHANGE=0 (the bench's barrier A/B leg) must
+    produce byte-identical blocks: pipelining is a scheduling change, not
+    a semantic one."""
+    items = [os.urandom(64) for _ in range(256)]
+
+    def blocks(seed):
+        refs = rd.from_items(items, parallelism=8).random_shuffle(
+            seed=seed)._block_refs()
+        return [ray_tpu.get(r, timeout=600) for r in refs]
+
+    monkeypatch.setenv("RT_DATA_PIPELINED_EXCHANGE", "1")
+    pipelined = blocks(13)
+    monkeypatch.setenv("RT_DATA_PIPELINED_EXCHANGE", "0")
+    barrier = blocks(13)
+    assert pipelined == barrier
+
+
+def test_iter_batches_streams_with_bounded_lookahead(ray_start_2cpu,
+                                                     monkeypatch):
+    """iter_batches over an unexecuted shuffle plan streams reduce outputs
+    without driver materialization: the unconsumed-block high-water mark
+    stays within RT_DATA_MAX_INFLIGHT_BLOCKS, and a fully drained stream
+    caches the refs so the second pass doesn't re-execute."""
+    from ray_tpu.data._internal import exchange as xch
+
+    monkeypatch.setenv("RT_DATA_MAX_INFLIGHT_BLOCKS", "4")
+    xch.reset_exchange_stats()
+    ds = rd.range(4096, parallelism=32).random_shuffle(seed=2)
+    assert ds._cached_refs is None
+    seen = []
+    for batch in ds.iter_batches(batch_size=256):
+        seen.extend(int(v) for v in batch["id"])
+    assert sorted(seen) == list(range(4096))
+    st = xch.exchange_stats()
+    assert 0 < st["stream_max_ahead"] <= 4, st
+    # Full drain cached the refs: second pass rides them, same rows.
+    assert ds._cached_refs is not None
+    again = []
+    for batch in ds.iter_batches(batch_size=256):
+        again.extend(int(v) for v in batch["id"])
+    assert again == seen
+
+
+def test_read_tasks_sized_by_block_bytes(tmp_path, monkeypatch):
+    """FileBasedDatasource groups files into RT_DATA_BLOCK_BYTES-target
+    read tasks: many small files pack into one task, one oversized
+    splittable file cuts into row-range slices, and unsplittable (binary)
+    files stay whole."""
+    from ray_tpu.data.datasource import BinaryDatasource, TextDatasource
+
+    small = tmp_path / "small"
+    small.mkdir()
+    for i in range(8):
+        (small / f"f{i}.txt").write_text("".join(
+            f"s{i}-{j}\n" for j in range(10)))  # ~60B each
+    sz = os.path.getsize(str(small / "f0.txt"))
+    monkeypatch.setenv("RT_DATA_BLOCK_BYTES", str(2 * sz + 1))
+    tasks = TextDatasource(str(small)).get_read_tasks(parallelism=1)
+    assert len(tasks) == 4  # 8 files packed 2 per ~2-file-sized block
+    rows = [r for t in tasks for r in t()["text"]]
+    assert len(rows) == 80 and rows[0] == "s0-0"
+
+    big = tmp_path / "big.txt"
+    big.write_text("".join(f"line-{j:04d}\n" for j in range(300)))
+    target = os.path.getsize(str(big)) // 3 + 1
+    monkeypatch.setenv("RT_DATA_BLOCK_BYTES", str(target))
+    tasks = TextDatasource(str(big)).get_read_tasks(parallelism=1)
+    assert len(tasks) == 3  # oversized file split into row-range slices
+    rows = [r for t in tasks for r in t()["text"]]
+    assert rows == [f"line-{j:04d}" for j in range(300)]
+
+    blob = tmp_path / "whole.bin"
+    blob.write_bytes(os.urandom(4096))
+    monkeypatch.setenv("RT_DATA_BLOCK_BYTES", "512")
+    tasks = BinaryDatasource(str(blob)).get_read_tasks(parallelism=1)
+    assert len(tasks) == 1  # unsplittable: one row per whole file
+    assert tasks[0]()["bytes"][0] == blob.read_bytes()
+
+
+def test_batch_format_preserves_trailing_nul_bytes():
+    """numpy's fixed-width S dtype treats trailing NULs as padding and
+    strips them on element access, so a bytes row ending in b"\\x00" used
+    to come out of iter_batches one byte short. Batch columns built from
+    bytes/str rows must use object dtype (caught by an end-to-end drive:
+    ~1 in 256 os.urandom rows ends with a NUL)."""
+    from ray_tpu.data.block import BlockAccessor, combine_blocks
+
+    rows = [b"ab\x00", b"\x00\x00", b"xy"]
+    batch = BlockAccessor.for_block(rows).to_batch()
+    assert [bytes(x) for x in batch["item"]] == rows
+
+    dict_rows = [{"k": r} for r in rows]
+    batch = BlockAccessor.for_block(dict_rows).to_batch()
+    assert [bytes(x) for x in batch["k"]] == rows
+
+    merged = combine_blocks([{"k": rows[:2]}, {"k": rows[2:]}])
+    assert [bytes(x) for x in merged["k"]] == rows
+
+    strs = ["a\x00", "\x00"]
+    batch = BlockAccessor.for_block(strs).to_batch()
+    assert list(batch["item"]) == strs

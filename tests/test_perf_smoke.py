@@ -18,7 +18,7 @@ def test_bench_smoke_runs():
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     out = subprocess.run(
         [sys.executable, os.path.join(root, "bench.py"), "--smoke"],
-        capture_output=True, text=True, timeout=300, env=env, cwd=root)
+        capture_output=True, text=True, timeout=420, env=env, cwd=root)
     assert out.returncode == 0, out.stderr[-2000:]
     rep = json.loads(out.stdout.strip().splitlines()[-1])
     assert rep["metric"] == "microbench_geomean"
@@ -207,3 +207,32 @@ def test_bench_smoke_runs():
             f"must be immediate, not queued behind the overload")
     assert rep["details"]["serve_overload_goodput_tok_s"] > 0, (
         "admitted streams made no goodput under overload")
+    # Streaming shuffle (ISSUE 19 acceptance): the pipelined exchange vs
+    # the barrier mode of the SAME multi-block random_shuffle, in GB/s.
+    # The floor is core-aware (the bench derives it: 1.5x where map and
+    # consolidation tasks can overlap, a noise-widened sanity floor on
+    # 1-core boxes where the extra consolidation hops are pure overhead
+    # — README "Data plane"), and the distributed rate must be a real
+    # fraction of a single-process numpy take() over the same rows.
+    sh_pipe = rep["details"].get("data_shuffle_gbps")
+    sh_barrier = rep["details"].get("data_shuffle_barrier_gbps")
+    assert sh_pipe and sh_barrier, (
+        "data_shuffle A/B missing (bench skipped it: see its stderr)")
+    sh_speedup = rep["details"]["data_shuffle_speedup"]
+    sh_floor = rep["details"]["data_shuffle_speedup_floor"]
+    assert sh_speedup >= sh_floor, (
+        f"pipelined shuffle is {sh_speedup}x barrier mode ({sh_pipe} vs "
+        f"{sh_barrier} GB/s medians) — below the core-aware gate floor "
+        f"({sh_floor}x)")
+    sh_vs_local = rep["details"]["data_shuffle_vs_local"]
+    sh_local_floor = rep["details"]["data_shuffle_vs_local_floor"]
+    assert sh_vs_local >= sh_local_floor, (
+        f"distributed shuffle moves {sh_vs_local}x of the single-process "
+        f"numpy baseline ({rep['details']['data_shuffle_local_gbps']} "
+        f"GB/s) — below the {sh_local_floor} floor for this core class")
+    # Streaming ingest (ISSUE 19 acceptance): iter_batches must stream
+    # the dataset end to end (read tasks through the bounded window into
+    # driver-side numpy batches) at a nonzero rate — a hang or a dropped
+    # row fails inside the bench lane itself.
+    assert rep["details"].get("data_ingest_gbps", 0) > 0, (
+        "data_ingest lane missing (bench skipped it: see its stderr)")
