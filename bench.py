@@ -303,6 +303,12 @@ def main(smoke: bool = False):
         # a capped LLM deployment — every client resolves, queue-full
         # sheds return in milliseconds, admitted streams make goodput.
         _bench_serve_overload(extra_details)
+        # Cross-host streaming & multi-proxy fan-out (perf-gate input,
+        # ISSUE 20): force-push legs prove the push-stream transport beats
+        # the per-item fallback a remote replica otherwise degrades to,
+        # and a 2-proxy fleet holds aggregate goodput against one proxy.
+        # TTFT p50/p99 under the 16-client heavy-tailed storm ride along.
+        _bench_serve_fanout(extra_details)
         # Streaming shuffle (perf-gate input, ISSUE 19): the SAME
         # multi-block random_shuffle with RT_DATA_PIPELINED_EXCHANGE=1 vs
         # =0 (reduce-side work held until the full map wave lands), in
@@ -1046,6 +1052,8 @@ def _bench_serve_decode_e2e(details: dict):
                 t.join(timeout=300)
             return sum(done)
 
+        ttfts: list[float] = []  # seconds to first token, every SSE leg
+
         def sse_clients() -> int:
             done = [0] * n_clients
 
@@ -1054,6 +1062,7 @@ def _bench_serve_decode_e2e(details: dict):
                     f"{base}/v1/completions", data=sse_body,
                     headers={"Content-Type": "application/json"})
                 n = 0
+                t0 = time.perf_counter()
                 with urllib.request.urlopen(req, timeout=300) as r:
                     for line in r:
                         line = line.decode().strip()
@@ -1061,6 +1070,8 @@ def _bench_serve_decode_e2e(details: dict):
                             continue
                         if line[6:] == "[DONE]":
                             break
+                        if n == 0:
+                            ttfts.append(time.perf_counter() - t0)
                         n += len(_json.loads(line[6:]).get("token_ids", []))
                 done[i] = n
 
@@ -1131,6 +1142,11 @@ def _bench_serve_decode_e2e(details: dict):
     details["serve_decode_e2e_tok_s"] = round(e2e_med, 1)
     details["serve_decode_e2e_ratio"] = round(ratio, 3)
     details["serve_decode_e2e_bound"] = bound
+    if ttfts:
+        details["serve_decode_ttft_p50_ms"] = round(
+            _percentile(ttfts, 50) * 1e3, 1)
+        details["serve_decode_ttft_p99_ms"] = round(
+            _percentile(ttfts, 99) * 1e3, 1)
 
 
 # ---- pipeline-parallel decode A/B (smoke only) ---------------------------
@@ -1441,6 +1457,174 @@ def _bench_serve_overload(details: dict):
             max(r[2] for r in shed), 2)
 
 
+def _bench_serve_fanout(details: dict):
+    """Cross-host token streaming + multi-proxy fan-out lane (smoke only;
+    README "Cross-host streaming & multi-proxy"). Two measurements, both
+    driving the same 16-client heavy-tailed SSE storm:
+
+    1. push vs per-item — RT_STREAM_FORCE_PUSH=1 makes every replica skip
+       the shm ring attach, so the handshake exercises exactly what a
+       remote-host replica would: the push-stream transport (RT_STREAM_PUSH
+       =1) vs the classic one-ObjectRef-per-item reply path (=0). Each leg
+       is a full cluster lifecycle — the knobs are read replica-side, and
+       workers inherit env at spawn. The gate is core-aware: where the
+       proxy, replicas, and clients actually get cores the push transport
+       must beat per-item by 1.5x; a 1-core box time-slices everything and
+       the floor degrades to a sanity bound.
+    2. multi-proxy fan-out — the same storm spread round-robin across 2
+       proxy processes vs 1 (same cluster, default shm transport):
+       aggregate goodput through the fleet must hold against the single
+       proxy (the replica-set is the bottleneck, the ingress must not be).
+
+    TTFT p50/p99 ride the details from the push legs; the p99 bound is
+    derived from serve_decode_e2e's recorded TTFT when present — an
+    internet-scale ingress may queue, but it must never let a client sit
+    unacknowledged."""
+    import json as _json
+    import statistics
+    import threading
+    import urllib.request
+
+    lengths = [8] * 10 + [32] * 4 + [96] * 2  # heavy-tailed, 16 clients
+    lcfg_kw = dict(vocab_size=384, d_model=64, n_layers=2, n_heads=4,
+                   max_seq=256)
+    ncpu = os.cpu_count() or 1
+
+    def storm(bases: list, ttfts=None) -> float:
+        """One 16-client storm round-robin across `bases`; returns tok/s.
+        Every client must stream its full generation — a lost token is a
+        lane failure, not a slow run."""
+        out = [None] * len(lengths)
+
+        def client(i):
+            body = _json.dumps({"prompt": "bench",
+                                "max_tokens": lengths[i],
+                                "temperature": 0.0,
+                                "stream": True}).encode()
+            req = urllib.request.Request(
+                f"{bases[i % len(bases)]}/v1/completions", data=body,
+                headers={"Content-Type": "application/json"})
+            n = 0
+            t0 = time.perf_counter()
+            with urllib.request.urlopen(req, timeout=300) as r:
+                for line in r:
+                    line = line.decode().strip()
+                    if not line.startswith("data: "):
+                        continue
+                    if line[6:] == "[DONE]":
+                        break
+                    ev = _json.loads(line[6:])
+                    if "error" in ev:
+                        raise RuntimeError(f"SSE error event: {ev}")
+                    if n == 0 and ttfts is not None:
+                        ttfts.append(time.perf_counter() - t0)
+                    n += len(ev.get("token_ids", []))
+            out[i] = n
+
+        t0 = time.perf_counter()
+        ts = [threading.Thread(target=client, args=(i,), daemon=True)
+              for i in range(len(lengths))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=300)
+        wall = time.perf_counter() - t0
+        if any(o is None for o in out):
+            raise RuntimeError("storm left clients hung or errored")
+        total = sum(out)
+        if total < sum(lengths):
+            raise RuntimeError(f"storm lost tokens: {total} < {sum(lengths)}")
+        return total / wall
+
+    def cycle(env: dict, n_proxies: int, ttfts=None, storms: int = 2):
+        """One full cluster lifecycle under `env`: init, deploy, warm every
+        chunk program AND the transport, measure, tear down. The env must
+        be set BEFORE init — replica/proxy processes inherit it at spawn."""
+        import ray_tpu
+        from ray_tpu import serve
+        from ray_tpu.llm import LLMConfig
+        from ray_tpu.llm.openai import build_openai_app
+
+        saved = {k: os.environ.get(k) for k in env}
+        os.environ.update(env)
+        try:
+            ray_tpu.init(num_cpus=4)
+            port = _free_port_bench()
+            app = build_openai_app(LLMConfig(**lcfg_kw), max_batch=8,
+                                   decode_chunk=8)
+            serve.run(app, route_prefix="/", port=port,
+                      num_proxies=n_proxies)
+            if n_proxies > 1:
+                bases = [f"http://127.0.0.1:{p}"
+                         for p in sorted(serve.proxy_ports().values())]
+            else:
+                bases = [f"http://127.0.0.1:{port}"]
+            storm(bases)  # warm
+            rates = [storm(bases, ttfts) for _ in range(storms)]
+            serve.shutdown()
+            return statistics.median(rates)
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            ray_tpu.shutdown()
+
+    try:
+        # --- 1. push-stream vs per-item fallback (force-push legs) -------
+        push_env = {"RT_STREAM_FORCE_PUSH": "1", "RT_STREAM_PUSH": "1"}
+        item_env = {"RT_STREAM_FORCE_PUSH": "1", "RT_STREAM_PUSH": "0"}
+        # One lifecycle per leg (each medians 2 storms after a warm storm):
+        # a lifecycle is ~30s of init+compile, so rounds are spent inside
+        # the leg, not on more legs.
+        push_ttfts: list = []
+        push_med = cycle(push_env, 1, push_ttfts)
+        item_med = cycle(item_env, 1)
+        push_ratio = push_med / max(item_med, 1e-9)
+        push_bound = 1.5 if ncpu >= 4 else 0.6
+
+        # --- 2. multi-proxy fan-out vs single proxy (shm transport) ------
+        multi_med = cycle({}, 2)
+        single_med = cycle({}, 1)
+        multi_ratio = multi_med / max(single_med, 1e-9)
+        multi_bound = 0.9 if ncpu >= 4 else 0.6
+
+        ttft_p50 = _percentile(push_ttfts, 50) * 1e3
+        ttft_p99 = _percentile(push_ttfts, 99) * 1e3
+        # An overloaded ingress may queue, but p99 TTFT stays bounded
+        # relative to the lightly-loaded serve_decode_e2e baseline (or an
+        # absolute floor when that lane didn't record one).
+        ttft_bound = max(5000.0,
+                         20.0 * details.get("serve_decode_ttft_p99_ms",
+                                            250.0))
+    except Exception as e:
+        log(f"  serve_fanout skipped: {e}")
+        try:
+            import ray_tpu
+
+            ray_tpu.shutdown()
+        except Exception:
+            pass
+        return
+    log(f"  serve_fanout: push-stream {push_med:,.0f} tok/s vs per-item "
+        f"{item_med:,.0f} tok/s ({push_ratio:.2f}x, bound {push_bound}x); "
+        f"2-proxy {multi_med:,.0f} tok/s vs 1-proxy {single_med:,.0f} "
+        f"tok/s ({multi_ratio:.2f}x, bound {multi_bound}x); "
+        f"TTFT p50 {ttft_p50:.0f}ms p99 {ttft_p99:.0f}ms")
+    details["serve_fanout_push_tok_s"] = round(push_med, 1)
+    details["serve_fanout_peritem_tok_s"] = round(item_med, 1)
+    details["serve_fanout_push_ratio"] = round(push_ratio, 3)
+    details["serve_fanout_push_bound"] = push_bound
+    details["serve_fanout_multi_tok_s"] = round(multi_med, 1)
+    details["serve_fanout_single_tok_s"] = round(single_med, 1)
+    details["serve_fanout_multi_ratio"] = round(multi_ratio, 3)
+    details["serve_fanout_multi_bound"] = multi_bound
+    details["serve_fanout_ttft_p50_ms"] = round(ttft_p50, 1)
+    details["serve_fanout_ttft_p99_ms"] = round(ttft_p99, 1)
+    details["serve_fanout_ttft_p99_bound_ms"] = round(ttft_bound, 1)
+
+
 def _bench_data_shuffle(details: dict):
     """Streaming shuffle A/B (smoke only; README "Data plane"): the SAME
     8-block random_shuffle through the exchange plane with pipelined
@@ -1587,6 +1771,13 @@ def _free_port_bench() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+def _percentile(vals: list, pct: float) -> float:
+    """Nearest-rank percentile on a copy (small-N latency samples)."""
+    xs = sorted(vals)
+    k = max(0, min(len(xs) - 1, int(round(pct / 100.0 * len(xs) + 0.5)) - 1))
+    return xs[k]
 
 
 def _bench_llm_decode(results: dict):

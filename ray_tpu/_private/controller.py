@@ -2216,6 +2216,30 @@ class Controller:
             age = round(time.time() - ring.last_ts, 3)
             if "sample_age" not in ent or age < ent["sample_age"]:
                 ent["sample_age"] = age  # freshest series wins
+        # Serve-plane summary (README "Cross-host streaming & multi-proxy"):
+        # per-proxy request/stream tallies plus the push-stream transport
+        # counters, scraped from the aggregated application metrics so
+        # `ray-tpu top` shows the ingress fleet without a second RPC.
+        proxies: dict[str, dict] = {}
+        stream = {"records": 0, "bytes": 0, "parks": 0}
+        for ent in self.metrics.values():
+            name = ent["name"]
+            if name.startswith("rt_serve_proxy_"):
+                pid = ent["tags"].get("proxy", "?")
+                row = proxies.setdefault(
+                    pid, {"requests": 0, "streams": 0, "active": 0})
+                if name == "rt_serve_proxy_requests_total":
+                    row["requests"] = int(ent["value"])
+                elif name == "rt_serve_proxy_streams_total":
+                    row["streams"] = int(ent["value"])
+                elif name == "rt_serve_proxy_active_streams":
+                    row["active"] = int(ent["value"])
+            elif name == "rt_stream_push_records_total":
+                stream["records"] = int(ent["value"])
+            elif name == "rt_stream_push_bytes_total":
+                stream["bytes"] = int(ent["value"])
+            elif name == "rt_stream_push_parks_total":
+                stream["parks"] = int(ent["value"])
         return {
             "nodes": nodes,
             "controller": {
@@ -2223,6 +2247,7 @@ class Controller:
                 "tables": self._table_sizes(),
                 "rpc_total": sum(v[0] for v in self._rpc_stats.values()),
             },
+            "serve": {"proxies": proxies, "stream": stream},
             "telemetry_armed": bool(self.telemetry) or
                 self._telem_task is not None,
             "now": time.time(),

@@ -118,6 +118,11 @@ KINDS: dict[str, tuple[str, str]] = {
     "serve_shed": ("warning", "serve admission control shed requests "
                               "(throttled aggregate; attrs carry the "
                               "per-reason counts since the last event)"),
+    "serve_proxy_join": ("info", "a serve HTTP proxy came up and joined "
+                                 "the controller's proxy registry"),
+    "serve_stream_sever": ("warning", "a push-stream link was severed (or "
+                                      "lost a frame) mid-stream; the SSE "
+                                      "client got an attributed error"),
     # --- compiled dataflow graphs (driver-emitted) -------------------------
     "dag_compiled": ("info", "a DAG was compiled into persistent stage "
                              "loops wired by pre-negotiated shm channels"),

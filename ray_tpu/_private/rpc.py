@@ -654,7 +654,14 @@ class Connection:
         # coalescing, push() returns once the frame is buffered, so a
         # push-then-close sequence (e.g. a worker's final task_done before
         # disconnect) must not drop the buffered frame. Bounded wait — a
-        # dead peer can't hold the close hostage.
+        # dead peer can't hold the close hostage. Best-effort only: the
+        # cancelled read task's teardown may set `closed` first and win
+        # the race. A caller that NEEDS every buffered frame delivered
+        # must ack at the protocol layer before closing (the way
+        # PushStreamWriter awaits its s_close reply) — reordering this
+        # drain ahead of the cancel leaves the connection half-open for
+        # up to 2s, which was observed to race the worker-death path into
+        # lost object-fetch wakeups (chaos shuffle test hang).
         if (self._wbuf or self._wflushing) and not self.closed:
             try:
                 await asyncio.wait_for(self._a_wait_flushed(), 2.0)
@@ -743,10 +750,17 @@ class RpcServer:
         on_request: Callable[[Connection, str, dict], Awaitable],
         on_push: Optional[Callable[[Connection, str, dict], Awaitable]] = None,
         on_close: Optional[Callable[[Connection], None]] = None,
+        label: str | None = None,
     ):
         self._on_request = on_request
         self._on_push = on_push
         self._on_close = on_close
+        # Fault-injection connection class stamped on every ACCEPTED
+        # connection: client ends get theirs from connect(label=...), but
+        # without this the server side of the same link is unaddressable
+        # by FaultInjector rules (e.g. recv-direction drops on a stream
+        # hub's inbound frames).
+        self._label = label
         self._server: Optional[asyncio.AbstractServer] = None
         self._uds_server: Optional[asyncio.AbstractServer] = None
         self.connections: set = set()
@@ -776,6 +790,7 @@ class RpcServer:
     async def _accept(self, reader, writer):
         _set_nodelay(writer)
         conn = Connection(reader, writer)
+        conn.label = self._label
         conn.on_request = self._on_request
         conn.on_push = self._on_push
         conn.on_close = self._conn_closed
@@ -993,6 +1008,7 @@ async def connect(
         client.peer, serv_end.peer = serv_end, client
         client.label = label
         client.on_request, client.on_push, client.on_close = on_request, on_push, on_close
+        serv_end.label = server._label
         serv_end.on_request = server._on_request
         serv_end.on_push = server._on_push
         serv_end.on_close = server._conn_closed

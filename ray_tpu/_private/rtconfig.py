@@ -288,6 +288,30 @@ _flag("serve_burst", int, 16)
 _flag("serve_retries", int, 2)
 # Base for the jittered exponential backoff between those retries.
 _flag("serve_retry_base_s", float, 0.05)
+# --- cross-host streaming & multi-proxy (README section of same name) -------
+# Push-stream transport: when a replica cannot attach the same-host shm
+# StreamRing (cross-host replica, no shared /dev/shm), token-batch records
+# ride the rpc transport to the proxy's per-process stream hub instead of
+# degrading to the per-item classic reply path. Same record contract,
+# bounded send window, burst coalescing into single frames. False restores
+# the nak -> per-item fallback for remote replicas.
+_flag("stream_push", bool, True)
+# Push-stream send window in bytes: the producer may have at most this
+# many un-acknowledged record bytes in flight (the consumer credits bytes
+# back as it drains). A stalled consumer parks the pump — bounded
+# buffering, exactly like the shm ring. A record may be at most half this.
+_flag("stream_window_bytes", int, 256 * 1024)
+# Test/bench hook: replicas skip the same-host shm attach so the push
+# transport is exercised on a single box (simulates a cross-host replica).
+# Never set in production — shm is strictly cheaper when it is available.
+_flag("stream_force_push", bool, False)
+# Number of HTTP proxy processes serve.run starts (serve.run(num_proxies=)
+# overrides). Proxy 0 binds the requested port, extras auto-bind; ports
+# are discoverable via serve.proxy_ports(). All proxies share replica-set
+# routing via the controller's versioned long-poll and run their own
+# admission queues — the replica-side concurrency backstop keeps racing
+# routers safe.
+_flag("serve_proxies", int, 1)
 # --- compiled dataflow graphs (README "Compiled graphs") --------------------
 # Max invocations a compiled DAG keeps in flight: execute() returns a
 # DagRef immediately and only blocks once this many invocations are still

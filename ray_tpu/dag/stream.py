@@ -15,7 +15,11 @@ variable-length records head-to-tail in a circular byte region so
 This is the serve→engine reply path of README "Serving hot loop": the
 replica's token pump writes SSE chunk records, the HTTP proxy reads
 batches and coalesces them into single socket flushes — zero per-token
-RPC, zero per-token ObjectRef. Writers may be multiple threads of ONE
+RPC, zero per-token ObjectRef. The same record contract is generalized
+onto the rpc transport for cross-host streams by dag/push_stream.py
+(PushStreamWriter/Reader: identical write/read_batch/close semantics,
+credit-window backpressure instead of ring-full parking); the serve
+handshake picks shm ring when it can attach, push-stream otherwise. Writers may be multiple threads of ONE
 process (engine emit thread + pump + error paths): writes serialize on an
 in-process lock. Cross-process stays single-producer/single-consumer,
 like the Channel it grows from.

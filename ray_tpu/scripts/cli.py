@@ -534,6 +534,21 @@ def _top_lines(rep: dict) -> list[str]:
         f"leases={tables.get('leases', 0)} "
         f"parked={tables.get('parked_grants', 0)} "
         f"rpcs={ctrl.get('rpc_total', 0)}")
+    # Ingress fleet + push-stream transport (README "Cross-host streaming
+    # & multi-proxy"): one row when any proxy has reported metrics.
+    serve = rep.get("serve") or {}
+    proxies = serve.get("proxies") or {}
+    if proxies:
+        frag = "  ".join(
+            f"{pid}: req={row.get('requests', 0)} "
+            f"sse={row.get('streams', 0)} active={row.get('active', 0)}"
+            for pid, row in sorted(proxies.items()))
+        stream = serve.get("stream") or {}
+        lines.append(
+            f"serve: {frag}  push-stream: "
+            f"recs={stream.get('records', 0)} "
+            f"bytes={_fmt_bytes(stream.get('bytes', 0))} "
+            f"parks={stream.get('parks', 0)}")
     if not rep.get("telemetry_armed"):
         lines.append("(telemetry idle — start the cluster with "
                      "RT_TELEMETRY_INTERVAL_S=1 for live samples)")

@@ -44,6 +44,7 @@ __all__ = [
     "deployment",
     "get_app_handle",
     "get_deployment_handle",
+    "proxy_ports",
     "run",
     "shutdown",
     "status",
@@ -175,29 +176,48 @@ def _deploy_app(app: Application, controller, route_prefix: Optional[str],
 
 def run(target: Application, *, route_prefix: str = "/",
         host: str = "127.0.0.1", port: int = 8000,
-        grpc_port: Optional[int] = None,
+        grpc_port: Optional[int] = None, num_proxies: Optional[int] = None,
         _blocking: bool = True, timeout_s: float = 60.0) -> DeploymentHandle:
     """Deploy an application and start the HTTP ingress (reference
     serve/api.py:run). grpc_port (0 = auto-pick) additionally starts the
     gRPC ingress (reference gRPCProxy, proxy.py:530): unary calls at
     /ray_tpu.serve.<deployment>/<method>, server streaming with the
-    'Stream' method suffix."""
+    'Stream' method suffix.
+
+    num_proxies (default RT_SERVE_PROXIES, normally 1) fans the HTTP
+    ingress out across N proxy processes: proxy 0 keeps the requested
+    `port` (and the classic PROXY_NAME, so single-proxy behavior is
+    unchanged), extras auto-bind free ports discoverable via
+    serve.proxy_ports(). Each proxy runs its own admission queues against
+    the same controller-published budgets — the replica-side concurrency
+    cap is the shared backstop (README "Cross-host streaming &
+    multi-proxy")."""
+    from ray_tpu._private.rtconfig import CONFIG
+
     if not isinstance(target, Application):
         raise TypeError("serve.run expects Deployment.bind(...)")
+    if num_proxies is None:
+        num_proxies = int(CONFIG.serve_proxies)
+    num_proxies = max(1, num_proxies)
     controller = _get_or_create_controller()
     ingress = _deploy_app(target, controller, route_prefix, {})
-    # HTTP proxy (one; reference runs one per node).
+    # HTTP proxy fleet (reference runs one per node).
     from ray_tpu.serve._private.proxy import Proxy
 
     proxy_cls = ray_tpu.remote(num_cpus=0, max_concurrency=64)(Proxy)
-    proxy = proxy_cls.options(name=PROXY_NAME, lifetime="detached",
-                              get_if_exists=True).remote(
-        CONTROLLER_NAME, host, port, grpc_port)
-    ray_tpu.get(proxy.ready.remote(), timeout=30)
+    proxies = []
+    for i in range(num_proxies):
+        name = PROXY_NAME if i == 0 else f"{PROXY_NAME}_{i}"
+        proxies.append(proxy_cls.options(
+            name=name, lifetime="detached", get_if_exists=True).remote(
+            CONTROLLER_NAME, host, port if i == 0 else 0,
+            grpc_port if i == 0 else None, proxy_id=name))
+    for proxy in proxies:
+        ray_tpu.get(proxy.ready.remote(), timeout=30)
     if grpc_port is not None:
         # The proxy may predate this run (get_if_exists reuses it with the
         # FIRST run's constructor args): start the ingress in-place.
-        ray_tpu.get(proxy.ensure_grpc.remote(grpc_port), timeout=30)
+        ray_tpu.get(proxies[0].ensure_grpc.remote(grpc_port), timeout=30)
     if _blocking:
         deadline = time.monotonic() + timeout_s
         st: dict = {}
@@ -215,6 +235,16 @@ def get_grpc_port() -> Optional[int]:
     """Bound gRPC ingress port of the running proxy (None if disabled)."""
     proxy = ray_tpu.get_actor(PROXY_NAME)
     return ray_tpu.get(proxy.grpc_ready.remote(), timeout=10)
+
+
+def proxy_ports() -> dict:
+    """proxy_id -> bound HTTP port for every proxy registered with the
+    controller. With num_proxies=1 this is {PROXY_NAME: port}; with a
+    fleet, clients (or an external load balancer) spread connections
+    across the returned ports."""
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    reg = ray_tpu.get(controller.list_proxies.remote(), timeout=10)
+    return {pid: info["port"] for pid, info in reg.items()}
 
 
 def status() -> dict:
@@ -235,17 +265,24 @@ def delete(name: str):
 
 
 def shutdown():
-    """Tear down all deployments, the proxy, and the controller."""
+    """Tear down all deployments, every registered proxy, and the
+    controller."""
     try:
         controller = ray_tpu.get_actor(CONTROLLER_NAME)
     except Exception:
         reset_routers()
         return
+    proxy_names = [PROXY_NAME]
+    try:
+        reg = ray_tpu.get(controller.list_proxies.remote(), timeout=10)
+        proxy_names += [p for p in reg if p != PROXY_NAME]
+    except Exception:
+        pass
     try:
         ray_tpu.get(controller.shutdown_all.remote(), timeout=30)
     except Exception:
         pass
-    for name in (PROXY_NAME, CONTROLLER_NAME):
+    for name in (*proxy_names, CONTROLLER_NAME):
         try:
             ray_tpu.kill(ray_tpu.get_actor(name))
         except Exception:

@@ -65,6 +65,11 @@ class ServeController:
         # serving until the new generation is ready
         self._retire_after_ready: dict[str, dict] = {}
         self._health_inflight: set[str] = set()
+        # HTTP proxy fleet registry (README "Cross-host streaming &
+        # multi-proxy"): proxy_id -> {host, port, pid}. Proxies register
+        # on ready() — including after a restart, which is how a SIGKILLed
+        # proxy rejoins the fleet — and serve.proxy_ports() reads it.
+        self._proxies: dict[str, dict] = {}
 
     # ------------------------------------------------------------ plumbing
     def _ensure_loop(self):
@@ -177,6 +182,19 @@ class ServeController:
                            else "UPDATING"),
             }
         return out
+
+    async def register_proxy(self, proxy_id: str, host: str, port: int,
+                             pid: int) -> None:
+        """Called by each HTTP proxy from ready(). Re-registration under
+        the same proxy_id (a restarted proxy, whose port/pid changed) is
+        an update, not an error — that IS the rejoin contract."""
+        self._proxies[proxy_id] = {
+            "host": host, "port": int(port), "pid": int(pid)}
+
+    async def list_proxies(self) -> dict:
+        """proxy_id -> {host, port, pid} for every proxy that has come up.
+        Backs serve.proxy_ports() and the /v1/stats fleet aggregation."""
+        return {k: dict(v) for k, v in self._proxies.items()}
 
     async def delete(self, name: str):
         st = self.deployments.pop(name, None)

@@ -14,6 +14,7 @@ import contextvars
 import json
 import logging
 import math
+import os
 import threading
 import time
 from typing import Optional
@@ -60,11 +61,15 @@ class _TokenBucket:
 
 class Proxy:
     def __init__(self, controller_name: str, host: str = "127.0.0.1",
-                 port: int = 8000, grpc_port: Optional[int] = None):
+                 port: int = 8000, grpc_port: Optional[int] = None,
+                 proxy_id: str = ""):
         self.controller_name = controller_name
         self.host, self.port = host, port
         self.grpc_port = grpc_port  # None = gRPC ingress off
         self._grpc_ingress = None
+        # Identity in the controller's proxy registry / metric tags; the
+        # default keeps single-proxy deployments stable across restarts.
+        self.proxy_id = proxy_id or "_serve_proxy"
         self.routes: dict[str, str] = {}
         self._version = -1
         self._site = None
@@ -79,14 +84,53 @@ class Proxy:
         # naks every request, so skip the 1MB ring setup/unlink for a
         # while instead of paying it per stream. Time-bounded (not
         # permanent) so a transient failure can't disable the ring path
-        # for a deployment forever.
+        # for a deployment forever. With the push transport armed a
+        # remote replica answers "push" instead of nakking, so this
+        # backoff only fires when BOTH transports are out.
         self._ring_nak: dict[str, float] = {}
+        # Push-stream hub (lazy; README "Cross-host streaming"): ONE rpc
+        # server per proxy process accepting token-record frames from
+        # replicas that cannot attach the shm ring.
+        self._hub = None
+        self._active_streams = 0
+        # (monotonic, [proxy names]) — controller proxy-registry cache so
+        # /v1/stats aggregation costs one controller round trip per ~2s,
+        # not per request.
+        self._proxy_registry_cache: tuple[float, list] = (-1e9, [])
+
+    def _sweep_dead_rings(self) -> None:
+        """Unlink /dev/shm stream-ring segments left by proxies that died
+        without running their per-stream unlink (a SIGKILLed proxy leaks
+        one ring segment per open stream). Ring names embed the creator
+        pid, so a segment is debris exactly when that pid is gone — live
+        proxies' rings are never touched."""
+        import glob
+
+        for path in glob.glob("/dev/shm/rtring_sse_*"):
+            stem = os.path.basename(path)[len("rtring_sse_"):]
+            try:
+                pid = int(stem.split("_", 1)[0])
+            except ValueError:
+                continue  # foreign or pre-pid naming: leave it alone
+            if pid == os.getpid():
+                continue
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+            except PermissionError:
+                pass  # alive under another uid
 
     async def ready(self) -> int:
         """Bind the HTTP server; returns the bound port."""
         if self._started:
             return self.port
         from aiohttp import web
+
+        self._sweep_dead_rings()
 
         app = web.Application()
         app.router.add_route("*", "/{tail:.*}", self._handle)
@@ -103,6 +147,13 @@ class Proxy:
         await site.start()
         self._site = site
         self._started = True
+        if self.port == 0:
+            # Auto-bound (extra proxies of a multi-proxy fleet): report
+            # the real port so serve.proxy_ports() can route clients.
+            try:
+                self.port = site._server.sockets[0].getsockname()[1]
+            except Exception:
+                pass
         self._resolver = resolver_for(asyncio.get_event_loop())
         # Populate the route table BEFORE declaring ready: serve.run
         # returns right after this, and the first request must not race
@@ -116,6 +167,26 @@ class Proxy:
             self.routes = rep["routes"]
         except Exception as e:
             logger.warning("serve proxy initial route fetch failed: %r", e)
+        # Join the controller's proxy registry: /v1/stats aggregation and
+        # serve.shutdown() discover the fleet there, and a RESTARTED proxy
+        # re-registers here — rejoining routing exactly like it joined.
+        try:
+            import os as _os
+
+            controller = ray_tpu.get_actor(self.controller_name)
+            ref = controller.register_proxy.remote(
+                self.proxy_id, self.host, self.port, _os.getpid())
+            await asyncio.get_event_loop().run_in_executor(
+                None, lambda r=ref: ray_tpu.get(r, timeout=5))
+            from ray_tpu._private.events import emit_event
+
+            emit_event("serve_proxy_join",
+                       f"proxy {self.proxy_id!r} serving "
+                       f"{self.host}:{self.port}",
+                       entity=(self.proxy_id,),
+                       attrs={"port": self.port, "pid": _os.getpid()})
+        except Exception as e:
+            logger.debug("serve proxy registration skipped: %r", e)
         if self.grpc_port is not None and self._grpc_ingress is None:
             from ray_tpu.serve._private.grpc_proxy import GrpcIngress
 
@@ -173,6 +244,84 @@ class Proxy:
             self._stream_pool = ThreadPoolExecutor(
                 max_workers=256, thread_name_prefix="rt-sse")
         return self._stream_pool
+
+    async def _ensure_hub(self):
+        """Lazy per-process push-stream hub: nothing binds (or costs a
+        frame) until the first streaming request with the push transport
+        armed."""
+        if self._hub is None:
+            from ray_tpu.dag.push_stream import PushStreamHub
+
+            hub = PushStreamHub()
+            host = self.host if self.host not in ("0.0.0.0", "::") \
+                else "127.0.0.1"
+            await hub.start(host)
+            self._hub = hub
+        return self._hub
+
+    async def admission_snapshot(self, deployment: str) -> dict:
+        """This process's admission/stream counters — the unit /v1/stats
+        aggregation sums across the proxy fleet."""
+        import os as _os
+
+        router = get_router(self.controller_name, deployment)
+        snap = dict(router.admission_stats() or {})
+        snap["pid"] = _os.getpid()
+        snap["active_streams"] = self._active_streams
+        return snap
+
+    async def _peer_snapshots(self, dep: str) -> dict:
+        """Admission snapshots of every OTHER registered proxy (empty for
+        a single-proxy fleet — the common case costs one cached registry
+        lookup and no peer calls). Dead/restarting peers are skipped; the
+        reconciled registry catches up when they rejoin."""
+        loop = asyncio.get_event_loop()
+        now = loop.time()
+        ts, names = self._proxy_registry_cache
+        if now - ts > 2.0:
+            try:
+                controller = ray_tpu.get_actor(self.controller_name)
+                ref = controller.list_proxies.remote()
+                reg = await loop.run_in_executor(
+                    None, lambda r=ref: ray_tpu.get(r, timeout=2))
+                names = sorted(reg or {})
+            except Exception:
+                names = []
+            self._proxy_registry_cache = (now, names)
+        peers: dict = {}
+        for name in names:
+            if name == self.proxy_id:
+                continue
+            try:
+                h = ray_tpu.get_actor(name)
+                ref = h.admission_snapshot.remote(dep)
+                snap = await loop.run_in_executor(
+                    None, lambda r=ref: ray_tpu.get(r, timeout=2))
+                if isinstance(snap, dict):
+                    peers[name] = snap
+            except Exception:
+                continue
+        return peers
+
+    def _mint_request(self) -> None:
+        try:
+            from ray_tpu.util import metrics as _m
+
+            _m.SERVE_PROXY_REQS.inc(1, tags={"proxy": self.proxy_id})
+        except Exception:
+            pass
+
+    def _mint_stream(self, delta: int) -> None:
+        self._active_streams = max(0, self._active_streams + delta)
+        try:
+            from ray_tpu.util import metrics as _m
+
+            if delta > 0:
+                _m.SERVE_PROXY_STREAMS.inc(1, tags={"proxy": self.proxy_id})
+            _m.SERVE_PROXY_ACTIVE.set(float(self._active_streams),
+                                      tags={"proxy": self.proxy_id})
+        except Exception:
+            pass
 
     def _bucket_shed(self, prefix: str, dep: str):
         """Front-door rate limit: returns a 429 response when the route's
@@ -240,11 +389,16 @@ class Proxy:
         line is gone, so mid-stream replica death is reported in-band —
         typed, naming the replica and its event-plane entity — instead of
         a bare repr the client can only string-match."""
+        from ray_tpu.dag.push_stream import StreamSevered
         from ray_tpu.exceptions import ActorDiedError, WorkerCrashedError
 
         err = {"type": type(e).__name__, "deployment": dep,
                "detail": str(e) or repr(e)}
-        if isinstance(e, (ActorDiedError, WorkerCrashedError)):
+        if isinstance(e, (ActorDiedError, WorkerCrashedError,
+                          StreamSevered)):
+            # A severed/corrupted push-stream link is attributed like a
+            # replica death: the client learns WHICH replica's stream was
+            # lost and where its fate is recorded, and may retry.
             entity = replica_id or dep
             err["replica"] = replica_id
             err["retriable"] = True
@@ -258,6 +412,7 @@ class Proxy:
         if m is None:
             return web.Response(status=404, text="no deployment matches path")
         _prefix, dep = m
+        self._mint_request()
         admission = bool(CONFIG.serve_admission)
         # Stats requests bypass both the token bucket and the admission
         # queue: observability must stay readable exactly when the
@@ -407,7 +562,29 @@ class Proxy:
                     serve_stats = router.admission_stats()
                     if serve_stats is not None:
                         result = dict(result)
-                        result["serve"] = serve_stats
+                        peers = await self._peer_snapshots(dep)
+                        if peers:
+                            # Multi-proxy fleet: active-slot/queue counts
+                            # are summed ACROSS proxies (each runs its own
+                            # admission queue against the shared budgets)
+                            # with a per-proxy breakdown alongside. A
+                            # single-proxy response stays byte-identical —
+                            # no peers, no extra keys.
+                            import os as _os
+
+                            agg = dict(serve_stats)
+                            per = {self.proxy_id: dict(
+                                serve_stats, pid=_os.getpid(),
+                                active_streams=self._active_streams)}
+                            for pname, snap in peers.items():
+                                agg["queued"] += int(snap.get("queued", 0))
+                                agg["shed_total"] += int(
+                                    snap.get("shed_total", 0))
+                                per[pname] = snap
+                            result["serve"] = agg
+                            result["serve_proxies"] = per
+                        else:
+                            result["serve"] = serve_stats
                 return self._to_response(result)
             except BackPressureError as e:
                 return self._shed_response(e)
@@ -437,11 +614,15 @@ class Proxy:
 
     async def _stream_from_ring(self, resp, ring, gen, loop):
         """Token-ring reply path (README "Serving hot loop"): drain item
-        batches from the shm ring — ONE reader wakeup and ONE socket flush
-        per burst, however many tokens it carries — until the producer's
-        end/err record. Replica death is detected via the stream task's
-        completion ref, so a dead producer surfaces an attributed error
-        within the resolver's poll cadence instead of hanging the SSE."""
+        batches from the transport — ONE reader wakeup and ONE socket
+        flush per burst, however many tokens it carries — until the
+        producer's end/err record. `ring` is either a shm StreamRing
+        (same-host) or a PushStreamReader (cross-host); both speak the
+        same read_batch contract. Replica death is detected via the
+        stream task's completion ref, so a dead producer surfaces an
+        attributed error within the resolver's poll cadence instead of
+        hanging the SSE."""
+        from ray_tpu.dag.push_stream import StreamSevered
         from ray_tpu.dag.stream import RingClosed
 
         cfut = self._resolver.submit(gen.completed())
@@ -469,6 +650,28 @@ class Proxy:
                 continue
             except RingClosed:
                 break
+            except StreamSevered as sev:
+                # The push link dropped (or lost a frame) mid-stream. If
+                # the replica itself died, the completion ref knows within
+                # its poll cadence — prefer that attribution; otherwise
+                # surface the sever itself (also attributed, retriable).
+                for _ in range(20):
+                    if cfut.done():
+                        exc = cfut.exception()
+                        if exc is not None:
+                            raise exc
+                        break
+                    await asyncio.sleep(0.25)
+                try:
+                    from ray_tpu._private.events import emit_event
+
+                    emit_event(
+                        "serve_stream_sever",
+                        f"push-stream severed mid-SSE: {sev}",
+                        entity=(self.proxy_id,))
+                except Exception:
+                    pass
+                raise
             buf = bytearray()
             done = False
             for rec in batch:
@@ -498,6 +701,7 @@ class Proxy:
 
         ring = None
         ring_spec = None
+        reader = None
         if CONFIG.token_ring and (
                 loop.time() - self._ring_nak.get(router.deployment, -1e9)
                 > 60.0):
@@ -506,13 +710,31 @@ class Proxy:
 
                 from ray_tpu.dag.stream import StreamRing
 
-                ring = StreamRing(f"sse_{uuid.uuid4().hex[:12]}",
-                                  int(CONFIG.token_ring_bytes))
+                # The pid in the name makes the segment attributable: a
+                # proxy that dies mid-stream (SIGKILL) can't run its
+                # unlink finally, so the next proxy to start sweeps ring
+                # files whose creator pid is gone (_sweep_dead_rings).
+                sid = f"sse_{os.getpid()}_{uuid.uuid4().hex[:12]}"
+                ring = StreamRing(sid, int(CONFIG.token_ring_bytes))
                 ring_spec = ring.spec()
             except Exception as e:
                 logger.debug("token ring unavailable (%r): classic path", e)
                 ring = None
                 ring_spec = None
+            if ring is not None and CONFIG.stream_push:
+                # Offer the push-stream transport alongside the shm ring
+                # (README "Cross-host streaming & multi-proxy"): a replica
+                # that can't mmap our /dev/shm segment — it lives on
+                # another host — dials back into this proxy's hub and
+                # answers the handshake with "push" instead of "nak".
+                try:
+                    window = int(CONFIG.stream_window_bytes)
+                    hub = await self._ensure_hub()
+                    reader = hub.open(sid, window)
+                    ring_spec["push"] = hub.spec(sid, window)
+                except Exception as e:
+                    logger.debug("push-stream hub unavailable (%r)", e)
+                    reader = None
         admission = bool(CONFIG.serve_admission)
         cancel = threading.Event() if admission else None
         meta: dict = {}
@@ -531,10 +753,14 @@ class Proxy:
         except asyncio.CancelledError:
             if ring is not None:
                 ring.close(unlink=True)
+            if reader is not None:
+                reader.close()
             raise
         except Exception as e:
             if ring is not None:
                 ring.close(unlink=True)
+            if reader is not None:
+                reader.close()
             if admission:
                 from ray_tpu.exceptions import (
                     ActorDiedError,
@@ -559,14 +785,16 @@ class Proxy:
             "Connection": "keep-alive"})
         await resp.prepare(request)
         self._pool()
+        self._mint_stream(+1)
         it = iter(gen)
         sentinel = object()
         try:
             carry = None  # a first item the ring handshake pass consumed
             if ring is not None:
                 # The replica's first generator item is the ring handshake
-                # (ok/nak). Anything else means a producer that ignored
-                # the ring ask — fall back and emit that item normally.
+                # (ok = shm ring / push = rpc push-stream / nak). Anything
+                # else means a producer that ignored the ring ask — fall
+                # back and emit that item normally.
                 ref = await loop.run_in_executor(
                     self._stream_pool, lambda: next(it, sentinel))
                 first = (sentinel if ref is sentinel
@@ -574,6 +802,12 @@ class Proxy:
                 if isinstance(first, dict) and "__rt_ring__" in first:
                     if first["__rt_ring__"] == "ok":
                         await self._stream_from_ring(resp, ring, gen, loop)
+                        return resp
+                    if first["__rt_ring__"] == "push" and reader is not None:
+                        # Remote replica: same drain loop, fed by the hub
+                        # reader (read_batch-compatible) instead of shm.
+                        await self._stream_from_ring(resp, reader, gen,
+                                                     loop)
                         return resp
                     self._ring_nak[router.deployment] = loop.time()
                 elif first is not sentinel:
@@ -617,6 +851,9 @@ class Proxy:
             del gen
             if ring is not None:
                 ring.close(unlink=True)
+            if reader is not None:
+                reader.close()
+            self._mint_stream(-1)
         return resp
 
     async def _assign_stream(self, router, req, model_id, ring_spec, loop,

@@ -226,15 +226,38 @@ class Replica:
                     hasattr(out, "__anext__") or (
                         hasattr(out, "__iter__")
                         and not isinstance(out, (str, bytes, dict)))):
-                try:
-                    from ray_tpu.dag.stream import StreamRing
+                from ray_tpu._private.rtconfig import CONFIG
 
-                    ring = StreamRing.attach(stream_ring)
-                except Exception:
-                    ring = None  # cross-host / missing shm: classic path
-                # The handshake is the ONLY generator item in ring mode —
-                # the proxy reads it once, then drains the ring.
-                yield {"__rt_ring__": "ok" if ring is not None else "nak"}
+                mode = "nak"
+                if "name" in stream_ring and not CONFIG.stream_force_push:
+                    try:
+                        from ray_tpu.dag.stream import StreamRing
+
+                        ring = StreamRing.attach(stream_ring)
+                        mode = "ok"
+                    except Exception:
+                        ring = None  # cross-host / missing shm
+                if (ring is None and stream_ring.get("push")
+                        and CONFIG.stream_push):
+                    # Same-host shm unavailable (remote replica): the
+                    # push-stream carries the SAME record contract over
+                    # rpc — write/close below are transport-agnostic.
+                    # Connect setup blocks (socket + s_open round trip):
+                    # keep it off the replica's event loop.
+                    try:
+                        from ray_tpu.dag.push_stream import PushStreamWriter
+
+                        ring = await asyncio.get_event_loop(
+                        ).run_in_executor(self._pool(), PushStreamWriter,
+                                          stream_ring["push"])
+                        mode = "push"
+                    except Exception:
+                        ring = None  # hub unreachable: classic path
+                        mode = "nak"
+                # The handshake is the ONLY generator item in ring/push
+                # mode — the proxy reads it once, then drains the
+                # transport.
+                yield {"__rt_ring__": mode}
             if ring is not None:
                 loop = asyncio.get_event_loop()
                 stop = threading.Event()

@@ -407,6 +407,39 @@ SERVE_QUEUE_DEPTH = Gauge(
     description="requests waiting in this router's deployment queue",
     tag_keys=("deployment",))
 
+#: Push-stream producer counters (README "Cross-host streaming &
+#: multi-proxy"), minted replica-side as coalesced s_data frames leave the
+#: send window. records/bytes track throughput of the cross-host token
+#: path; parks counts write() episodes that hit window exhaustion — a
+#: sustained park rate means the consumer (proxy/SSE client) is the
+#: bottleneck, not the replica.
+STREAM_PUSH_RECORDS = Counter(
+    "rt_stream_push_records_total",
+    description="records sent over the push-stream transport")
+STREAM_PUSH_BYTES = Counter(
+    "rt_stream_push_bytes_total",
+    description="record bytes sent over the push-stream transport")
+STREAM_PUSH_PARKS = Counter(
+    "rt_stream_push_parks_total",
+    description="push-stream write parks on an exhausted send window")
+
+#: Per-proxy ingress counters: with N proxies behind one endpoint these
+#: attribute load to the process that carried it (the aggregate is the
+#: cluster's serving ingress rate). active_streams is the live SSE count
+#: per proxy — the fan-out the stream thread pool is actually holding.
+SERVE_PROXY_REQS = Counter(
+    "rt_serve_proxy_requests_total",
+    description="HTTP requests handled, by proxy process",
+    tag_keys=("proxy",))
+SERVE_PROXY_STREAMS = Counter(
+    "rt_serve_proxy_streams_total",
+    description="SSE streams opened, by proxy process",
+    tag_keys=("proxy",))
+SERVE_PROXY_ACTIVE = Gauge(
+    "rt_serve_proxy_active_streams",
+    description="SSE streams currently open, by proxy process",
+    tag_keys=("proxy",))
+
 #: Per-attempt execution deadlines that fired (@remote(timeout_s=...)),
 #: minted worker-side as the deadline interrupts the attempt. A non-zero
 #: rate under a healthy workload means timeout_s is set too tight — or

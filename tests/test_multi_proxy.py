@@ -1,0 +1,249 @@
+"""Multi-proxy fan-out (README "Cross-host streaming & multi-proxy"):
+N proxy processes share one replica fleet via the controller's routing,
+each with its own admission queues against the shared budgets.
+
+Pins the fleet contract end to end: scale-out on a later serve.run, the
+same bytes through every proxy, /v1/stats aggregation across the fleet
+(single-proxy response shape untouched), the replica-side concurrency
+cap as the shared admission backstop at N>1, and the chaos story — a
+SIGKILLed proxy fails ITS clients fast while the survivor's streams run
+uninterrupted, and a later serve.run rejoins a fresh proxy under the
+same name once the controller marks the old actor dead.
+"""
+
+import json
+import os
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu import serve
+
+CFG_KW = dict(vocab_size=384, d_model=64, n_layers=2, n_heads=4,
+              max_seq=256)
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _llm_app(**kw):
+    from ray_tpu.llm import LLMConfig
+    from ray_tpu.llm.openai import build_openai_app
+
+    return build_openai_app(LLMConfig(**CFG_KW), max_batch=4,
+                            decode_chunk=4, **kw)
+
+
+def _sse_tokens(port, max_tokens, on_first=None, timeout=120):
+    """Streamed completion via one proxy. Returns (token_ids, error):
+    error is the structured SSE error event if one arrived, or
+    "connection dropped" when the stream ended without its [DONE]
+    terminator (a dead proxy can only drop the socket — the missing
+    terminator IS the client-visible failure signal)."""
+    body = json.dumps({"model": "m", "prompt": "the quick brown",
+                       "max_tokens": max_tokens, "stream": True,
+                       "temperature": 0.0}).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/completions", data=body,
+        headers={"Content-Type": "application/json"})
+    toks = []
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        for line in resp:
+            line = line.decode().strip()
+            if not line.startswith("data: "):
+                continue
+            data = line[6:]
+            if data == "[DONE]":
+                return toks, None
+            ev = json.loads(data)
+            if "error" in ev:
+                return toks, ev["error"]
+            toks.extend(ev.get("token_ids", []) or [])
+            if on_first is not None:
+                on_first.set()
+    return toks, "connection dropped"
+
+
+def _stats(port):
+    return json.loads(urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/v1/stats", timeout=30).read())
+
+
+def test_fleet_scale_out_sigkill_and_rejoin(shutdown_only):
+    """The fleet lifecycle end to end, one cluster. Scale-out:
+    serve.run(num_proxies=1) then the SAME app at 2 proxies — proxy 0
+    keeps its port, the extra auto-binds and registers, both serve
+    byte-identical greedy streams, /v1/stats aggregates the fleet while
+    the single-proxy response shape stays exactly as before (no
+    serve_proxies key). Chaos: SIGKILL one proxy mid-SSE — its clients
+    fail fast at the HTTP layer (the dead proxy can't write — a
+    transport error, never a hang), the survivor's streams finish
+    byte-complete, and a later serve.run rejoins a fresh proxy under the
+    same name via the controller's DEAD-actor name reuse."""
+    from ray_tpu.serve._private.controller import CONTROLLER_NAME
+
+    ray_tpu.init(num_cpus=4)
+    port = _free_port()
+    app = _llm_app()
+    serve.run(app, port=port, num_proxies=1)
+
+    single = _stats(port)
+    assert "serve" in single
+    assert "serve_proxies" not in single, (
+        "single-proxy /v1/stats grew a fleet key — shape must stay "
+        "byte-identical")
+    assert serve.proxy_ports() == {"_serve_proxy": port}
+
+    serve.run(app, port=port, num_proxies=2)
+    ports = serve.proxy_ports()
+    assert len(ports) == 2 and ports["_serve_proxy"] == port
+    victim = next(n for n in ports if n != "_serve_proxy")
+    extra = ports[victim]
+    assert extra != port
+
+    toks0, err0 = _sse_tokens(port, 32)
+    toks1, err1 = _sse_tokens(extra, 32)
+    assert err0 is None and err1 is None
+    assert len(toks0) == 32
+    assert toks1 == toks0, "proxies disagreed on a greedy decode"
+
+    agg = _stats(port)
+    assert "serve_proxies" in agg and len(agg["serve_proxies"]) == 2
+    for name, snap in agg["serve_proxies"].items():
+        assert "pid" in snap and "active_streams" in snap, (name, snap)
+    assert "serve" in agg
+
+    controller = ray_tpu.get_actor(CONTROLLER_NAME)
+    reg = ray_tpu.get(controller.list_proxies.remote(), timeout=10)
+    victim_pid = reg[victim]["pid"]
+
+    outcomes = {}
+    started = threading.Event()
+
+    def survivor():
+        outcomes["survivor"] = _sse_tokens(port, 64)
+
+    def victim_client():
+        try:
+            outcomes["victim"] = ("done", _sse_tokens(
+                extra, 64, on_first=started))
+        except Exception as e:
+            outcomes["victim"] = ("failed", repr(e), time.monotonic())
+
+    ts = [threading.Thread(target=survivor, daemon=True),
+          threading.Thread(target=victim_client, daemon=True)]
+    for t in ts:
+        t.start()
+    assert started.wait(timeout=60), "victim stream never started"
+    t_kill = time.monotonic()
+    os.kill(victim_pid, 9)
+    for t in ts:
+        t.join(timeout=60)
+    assert not any(t.is_alive() for t in ts), "a client hung after the kill"
+
+    # The dead proxy's client fails at the transport layer, fast.
+    kind = outcomes["victim"][0]
+    if kind == "done":
+        # Either the stream raced to completion before the kill landed,
+        # or the drop was visible — a missing [DONE]/an error event.
+        toks, err = outcomes["victim"][1]
+        assert err is not None or len(toks) == 64
+    else:
+        t_fail = outcomes["victim"][2]
+        assert t_fail - t_kill < 15.0, (
+            f"victim client took {t_fail - t_kill:.1f}s "
+            f"after the kill to fail")
+    # The survivor never noticed.
+    toks, err = outcomes["survivor"]
+    assert err is None and len(toks) == 64, (len(toks), err)
+
+    # Rejoin: the controller must first mark the killed actor DEAD, then
+    # the same serve.run re-creates the proxy under the same name.
+    deadline = time.monotonic() + 45
+    rejoined = False
+    while time.monotonic() < deadline and not rejoined:
+        try:
+            serve.run(app, port=port, num_proxies=2)
+            rejoined = True
+        except Exception:
+            time.sleep(1.0)
+    assert rejoined, "serve.run could not rejoin a proxy within 45s"
+    new_ports = serve.proxy_ports()
+    assert victim in new_ports
+    toks, err = _sse_tokens(new_ports[victim], 16)
+    assert err is None and len(toks) == 16, (
+        "rejoined proxy not serving streams")
+    serve.shutdown()
+
+
+def test_admission_backstop_across_proxies(shutdown_only):
+    """A storm split across BOTH proxies against one capped replica: each
+    proxy runs its own admission queue, the replica-side concurrency cap
+    is the shared backstop. Every client resolves — 200, or typed
+    429/503 JSON within the queue deadline. Zero bare 500s, zero
+    hangs."""
+    ray_tpu.init(num_cpus=4)
+
+    @serve.deployment(max_ongoing_requests=4, max_queued_requests=4,
+                      queue_deadline_s=1.5,
+                      ray_actor_options={"num_cpus": 0.5})
+    class Work:
+        def __call__(self, request=None):
+            time.sleep(0.4)
+            return {"pid": os.getpid()}
+
+    port = _free_port()
+    serve.run(Work.bind(), port=port, num_proxies=2)
+    ports = list(serve.proxy_ports().values())
+    assert len(ports) == 2
+
+    results = []
+    lock = threading.Lock()
+
+    def client(i):
+        url = f"http://127.0.0.1:{ports[i % 2]}/"
+        t0 = time.monotonic()
+        try:
+            body = urllib.request.urlopen(url, timeout=30).read()
+            out = (200, json.loads(body), time.monotonic() - t0)
+        except urllib.error.HTTPError as e:
+            try:
+                payload = json.loads(e.read())
+            except Exception:
+                payload = None
+            out = (e.code, payload, time.monotonic() - t0)
+        except Exception as e:
+            out = (-1, repr(e), time.monotonic() - t0)
+        with lock:
+            results.append(out)
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(24)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not any(t.is_alive() for t in threads), "hung clients"
+
+    assert len(results) == 24
+    ok = [r for r in results if r[0] == 200]
+    shed = [r for r in results if r[0] in (429, 503)]
+    other = [r for r in results if r[0] not in (200, 429, 503)]
+    assert not other, f"bare failures: {other}"
+    assert ok, "storm starved every client"
+    for status, payload, elapsed in shed:
+        assert isinstance(payload, dict) and "error" in payload, (
+            f"shed response not typed JSON: {payload}")
+        # queue_deadline_s=1.5 plus scheduling slack: shed, never stalled
+        assert elapsed < 10.0, f"shed took {elapsed:.1f}s"
+    serve.shutdown()
+
+

@@ -1,0 +1,348 @@
+"""Push-stream transport: the StreamRing record contract over rpc
+(README "Cross-host streaming & multi-proxy").
+
+Unit layer: one in-process hub + writer pair per test — the same wiring a
+proxy/replica pair uses, minus the processes — driven through the ring
+calling convention (write / read_batch / close). Chaos layer: the rpc
+FaultInjector's "stream"-labeled rules prove the attributed-death
+contract frame by frame: a duplicated frame is discarded (byte-identical
+outcome), a dropped frame — middle OR tail — surfaces as StreamSevered
+(attributed outcome), never silent corruption. Serve layer:
+RT_STREAM_FORCE_PUSH=1 makes every replica answer the ring handshake the
+way a remote-host replica would, so the full proxy->replica SSE path
+runs over the push transport on one box.
+"""
+
+import glob
+import json
+import os
+import socket
+import threading
+import time
+import urllib.request
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private import rpc
+from ray_tpu.dag.push_stream import (
+    PushStreamHub,
+    PushStreamWriter,
+    StreamSevered,
+)
+from ray_tpu.dag.stream import RingClosed
+
+
+def _wait(pred, timeout, what):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"timed out waiting for {what}")
+
+
+class _Pair:
+    """One hub + one connected writer on private event-loop threads."""
+
+    def __init__(self, window: int = 64 * 1024):
+        self.io = rpc.EventLoopThread(name="ps-hub")
+        self.hub = PushStreamHub()
+        self.io.run(self.hub.start("127.0.0.1"))
+        self.reader = self.hub.open("s", window)
+        self.writer = PushStreamWriter(self.hub.spec("s", window))
+
+    def drain(self, timeout=10.0):
+        """Read to end-of-stream; returns (records, terminal exception)."""
+        got = []
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                got.extend(self.reader.read_batch(timeout=0.5))
+            except TimeoutError:
+                continue
+            except (RingClosed, StreamSevered) as e:
+                return got, e
+        raise AssertionError("stream never terminated")
+
+    def close(self):
+        for fn in (self.writer.close, lambda: self.io.run(self.hub.stop())):
+            try:
+                fn()
+            except Exception:
+                pass
+        self.io.stop()
+
+
+# ------------------------------------------------------------- unit layer
+def test_roundtrip_batch_drain():
+    """Records arrive in order through the ring calling convention, one
+    read_batch drains a buffered burst, and close() lands as RingClosed
+    only after everything is drained (the bug class: s_close overtaking
+    the final coalesced s_data frame)."""
+    p = _Pair()
+    try:
+        for i in range(500):
+            p.writer.write(("item", i))
+        p.writer.write(("end", None))
+        p.writer.close()
+        got, term = p.drain()
+        assert isinstance(term, RingClosed)
+        assert got == [("item", i) for i in range(500)] + [("end", None)]
+    finally:
+        p.close()
+
+
+def test_burst_coalesces_into_one_frame():
+    """Records written while the IO loop is busy accrete behind one
+    scheduled flush and ride ONE s_data frame — the per-burst (not
+    per-record) framing the transport exists for."""
+    p = _Pair()
+    try:
+        p.writer.write("warm")
+        _wait(lambda: p.writer._seq == 1 and p.writer._inflight == 0, 5,
+              "warm flush")
+        # Park the writer's IO loop; everything written meanwhile shares
+        # the single flush that runs when it wakes.
+        p.writer._loop.call_soon_threadsafe(time.sleep, 0.3)
+        for i in range(50):
+            p.writer.write(i)
+        _wait(lambda: len(p.reader._recs) >= 51, 5, "burst delivery")
+        assert p.writer._seq == 2, "burst split across frames"
+        batch = p.reader.read_batch(timeout=1)
+        assert batch == ["warm"] + list(range(50))
+    finally:
+        p.close()
+
+
+def test_backpressure_parks_writer_until_consumer_drains():
+    """A stalled consumer exhausts the credit window: write() parks (and
+    times out if asked to), and one consumer drain releases it — bounded
+    buffering, exactly like a full shm ring."""
+    p = _Pair(window=8192)
+    try:
+        blob = "x" * 1000
+        with pytest.raises(TimeoutError):
+            for _ in range(32):  # credit 8KB + pending 8KB < 32KB offered
+                p.writer.write(blob, timeout=0.3)
+        drained = p.reader.read_batch(timeout=5)
+        assert drained, "consumer saw nothing despite a full window"
+        p.writer.write(blob, timeout=5)  # credit returned: unparked
+    finally:
+        p.close()
+
+
+def test_oversize_record_rejected():
+    p = _Pair(window=8192)
+    try:
+        with pytest.raises(ValueError):
+            p.writer.write("y" * 10000)
+        p.writer.write("fits")  # the stream survives the rejection
+        assert p.reader.read_batch(timeout=5) == ["fits"]
+    finally:
+        p.close()
+
+
+def test_write_after_close_raises_ring_closed():
+    p = _Pair()
+    try:
+        p.writer.write("a")
+        p.writer.close()
+        p.writer.close()  # idempotent
+        with pytest.raises(RingClosed):
+            p.writer.write("b")
+        got, term = p.drain()
+        assert got == ["a"] and isinstance(term, RingClosed)
+    finally:
+        p.close()
+
+
+# ------------------------------------------------------------ chaos layer
+@pytest.fixture
+def stream_injector():
+    inj = rpc.enable_fault_injection()
+    inj.clear()
+    yield inj
+    inj.clear()
+    rpc.disable_fault_injection()
+
+
+def test_dup_frame_discarded_byte_identical(stream_injector):
+    """A duplicated s_data frame is discarded by seq — the consumer's
+    record stream is byte-identical to the clean run."""
+    rule = stream_injector.add_rule(
+        "stream", "dup", direction="send", methods={"s_data"},
+        after=1, times=1)
+    p = _Pair()
+    try:
+        for i in range(20):
+            p.writer.write(i)
+            time.sleep(0.01)  # separate frames so the dup hits one
+        p.writer.close()
+        got, term = p.drain()
+        assert isinstance(term, RingClosed)
+        assert got == list(range(20)), "dup frame leaked records"
+        assert rule.applied == 1
+    finally:
+        p.close()
+
+
+def test_dropped_middle_frame_severs_with_gap(stream_injector):
+    """A dropped s_data frame is detected as a seq gap by its successor
+    and surfaces as StreamSevered — attributed, never silently skipped."""
+    stream_injector.add_rule(
+        "stream", "drop", direction="send", methods={"s_data"},
+        after=2, times=1)
+    p = _Pair()
+    try:
+        for i in range(20):
+            p.writer.write(i)
+            time.sleep(0.01)
+        p.writer.close()
+        got, term = p.drain()
+        assert isinstance(term, StreamSevered), (got, term)
+        assert "gap" in str(term)
+        assert got == got[: len(got)], "records out of order"
+        assert len(got) < 20, "drop delivered everything anyway"
+    finally:
+        p.close()
+
+
+def test_dropped_tail_frame_severs_via_close_seq(stream_injector):
+    """A lost TAIL frame has no successor to expose its gap — the s_close
+    record carries the producer's final frame count and catches it. The
+    outcome is StreamSevered, not a clean close missing records."""
+    p = _Pair()
+    try:
+        for i in range(10):
+            p.writer.write(i)
+            time.sleep(0.01)
+        _wait(lambda: p.writer._inflight == 0, 5, "frames on the wire")
+        # Arm the drop for the LAST frame only, then write it.
+        stream_injector.add_rule(
+            "stream", "drop", direction="send", methods={"s_data"},
+            times=1)
+        p.writer.write("tail")
+        p.writer.close()
+        got, term = p.drain()
+        assert isinstance(term, StreamSevered), (got, term)
+        assert "tail" not in got
+        assert "lost tail" in str(term)
+    finally:
+        p.close()
+
+
+def test_severed_connection_surfaces_both_ends(stream_injector):
+    """An injected sever mid-stream: the reader raises StreamSevered and
+    a parked/subsequent write raises too — neither side hangs."""
+    stream_injector.add_rule(
+        "stream", "sever", direction="send", methods={"s_data"}, after=1)
+    p = _Pair()
+    try:
+        p.writer.write("a")
+        time.sleep(0.05)
+        with pytest.raises((StreamSevered, TimeoutError)):
+            for _ in range(200):
+                p.writer.write("b", timeout=0.1)
+                time.sleep(0.01)
+        got, term = p.drain()
+        assert isinstance(term, StreamSevered)
+    finally:
+        p.close()
+
+
+# ------------------------------------------------------------ serve layer
+CFG_KW = dict(vocab_size=384, d_model=64, n_layers=2, n_heads=4,
+              max_seq=128)
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _openai_app(port, **kw):
+    from ray_tpu import serve
+    from ray_tpu.llm import LLMConfig
+    from ray_tpu.llm.openai import build_openai_app
+
+    app = build_openai_app(LLMConfig(**CFG_KW), max_batch=4, decode_chunk=4,
+                           default_max_tokens=8, **kw)
+    serve.run(app, route_prefix="/", port=port)
+
+
+def _sse_request(base, max_tokens, timeout=120):
+    body = json.dumps({"model": "m", "prompt": "hello", "max_tokens":
+                       max_tokens, "stream": True,
+                       "temperature": 0.0}).encode()
+    req = urllib.request.Request(base + "/v1/completions", data=body,
+                                 headers={"Content-Type":
+                                          "application/json"})
+    return urllib.request.urlopen(req, timeout=timeout)
+
+
+def _drain_sse(resp):
+    toks, events = [], []
+    for line in resp:
+        line = line.decode().strip()
+        if not line.startswith("data: "):
+            continue
+        data = line[6:]
+        if data == "[DONE]":
+            break
+        ev = json.loads(data)
+        events.append(ev)
+        toks.extend(ev.get("token_ids", []) or [])
+    return toks, events
+
+
+def _stats(base):
+    return json.loads(urllib.request.urlopen(
+        base + "/v1/stats", timeout=30).read())
+
+
+def test_force_push_serve_stream_and_sigkill_attributed(shutdown_only,
+                                                        monkeypatch):
+    """One cluster, both halves of the serve-layer contract. Clean path:
+    a full SSE decode with the replica forced onto the push transport —
+    every requested token arrives, coalesced frames and all, and the
+    stream terminates cleanly. Chaos path: replica SIGKILL mid-stream —
+    the open SSE client gets a structured error naming the replica and
+    the `ray-tpu events` pointer — never a hang, never a bare disconnect
+    (the attributed-death contract, now over the rpc transport)."""
+    monkeypatch.setenv("RT_STREAM_FORCE_PUSH", "1")
+    ray_tpu.init(num_cpus=4)
+    port = _free_port()
+    _openai_app(port)
+    base = f"http://127.0.0.1:{port}"
+    toks, events = _drain_sse(_sse_request(base, 48))
+    assert len(toks) == 48, f"lost tokens: {len(toks)}"
+    assert all("error" not in ev for ev in events)
+    pid = _stats(base)["pid"]
+
+    resp = _sse_request(base, 96)
+    got_err = {}
+    deadline = time.monotonic() + 45
+    killed = False
+    for line in resp:
+        line = line.decode().strip()
+        if not line.startswith("data: "):
+            continue
+        data = line[6:]
+        if data == "[DONE]":
+            break
+        ev = json.loads(data)
+        if "error" in ev:
+            got_err = ev["error"]
+            break
+        if not killed:
+            os.kill(pid, 9)
+            killed = True
+        assert time.monotonic() < deadline, "no attributed error in 45s"
+    assert killed, "stream ended before the kill landed"
+    assert got_err, "stream ended with no structured error"
+    assert "events" in got_err and "ray-tpu events" in got_err["events"]
+    from ray_tpu import serve
+
+    serve.shutdown()
